@@ -1,0 +1,24 @@
+"""simple-tip-tpu: a TPU-native framework for DNN test-input prioritization (TIP)
+and active learning.
+
+Re-implements the full capability surface of the `testingautomated-usi/simple-tip`
+reproduction package (ISSTA 2022, Weiss & Tonella) with a JAX/XLA/pjit-first
+design:
+
+- ``ops``      pure functional metric kernels (uncertainty, neuron coverage,
+               surprise adequacy, APFD, CTM/CAM prioritizers) built on jnp/vmap.
+- ``models``   Flax models for the four case studies, with activation taps that
+               preserve the reference's Keras layer-index semantics.
+- ``parallel`` device-mesh ensemble execution: the reference's process-pool
+               "100 independent runs" axis becomes a vmapped parameter ensemble
+               sharded over a `jax.sharding.Mesh`.
+- ``engine``   experiment phases (training, test_prio, active_learning,
+               at_collection) writing the same filesystem artifact contract as
+               the reference, so downstream evaluation is drop-in comparable.
+- ``plotters`` result aggregation: APFD tables, active-learning tables,
+               Wilcoxon/A12 statistics.
+
+See SURVEY.md at the repo root for the file:line mapping to the reference.
+"""
+
+__version__ = "0.1.0"

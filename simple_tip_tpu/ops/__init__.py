@@ -1,0 +1,11 @@
+"""Pure, framework-light metric kernels (the reusable library layer).
+
+TPU-native counterpart of the reference's ``src/core/`` (see SURVEY.md section
+2.1): every kernel is a pure function over arrays, usable from numpy on host or
+jnp under jit/vmap on device.
+"""
+
+from simple_tip_tpu.ops.apfd import apfd_from_order, apfd_from_orders
+from simple_tip_tpu.ops.timer import Timer
+
+__all__ = ["apfd_from_order", "apfd_from_orders", "Timer"]

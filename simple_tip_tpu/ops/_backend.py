@@ -1,0 +1,32 @@
+"""Numpy/jnp backend dispatch for the metric kernels.
+
+Kernels accept either numpy arrays (host path — float64 exactness, used by the
+oracle tests and small host-side computations) or jax arrays (device path —
+used inside jitted pipelines). The array's own type picks the namespace.
+"""
+
+import numpy as np
+
+
+def xp_for(a):
+    """Return numpy or jax.numpy depending on the array type of ``a``."""
+    try:
+        import jax
+
+        if isinstance(a, jax.Array):
+            import jax.numpy as jnp
+
+            return jnp
+    except ImportError:  # pragma: no cover
+        pass
+    return np
+
+
+def is_jax(a) -> bool:
+    """True if ``a`` is a jax array."""
+    try:
+        import jax
+
+        return isinstance(a, jax.Array)
+    except ImportError:  # pragma: no cover
+        return False

@@ -1,0 +1,51 @@
+"""Average Percentage of Fault Detection (APFD).
+
+Behavioral contract matches the reference (reference: src/core/apfd.py:8-19):
+``1 - sum(fault_orders) / (k*n) + 1/(2n)`` where fault orders are 1-based ranks
+of misclassified samples in the prioritized order.
+
+Two entry points:
+
+- ``apfd_from_order``: host-side scalar, exact float64 (used by evaluation).
+- ``apfd_from_orders``: batched jnp kernel — evaluates a whole
+  (approach x model) grid of orders in one fused XLA program; the evaluation
+  phase over 39 approaches x 100 runs becomes a single device call.
+"""
+
+from typing import List, Union
+
+import numpy as np
+
+
+def apfd_from_order(is_fault, index_order: Union[List[int], np.ndarray]) -> float:
+    """APFD of one prioritization order given the per-sample fault mask."""
+    is_fault = np.asarray(is_fault)
+    assert is_fault.ndim == 1, "at the moment, only unique faults are supported"
+    ordered_faults = is_fault[np.asarray(index_order)]
+    fault_indexes = np.where(ordered_faults == 1)[0]
+    k = np.count_nonzero(is_fault)
+    n = is_fault.shape[0]
+    # +1: first sample has index 0 but rank 1
+    sum_of_fault_orders = np.sum(fault_indexes + 1)
+    return 1 - (sum_of_fault_orders / (k * n)) + (1 / (2 * n))
+
+
+def apfd_from_orders(is_fault, index_orders) -> "np.ndarray":
+    """Batched APFD: ``index_orders`` has shape (batch, n); ``is_fault`` is
+    (n,) or (batch, n). Returns (batch,) APFD values.
+
+    Pure jnp so it can be jitted/vmapped; ranks are computed without any
+    data-dependent control flow.
+    """
+    import jax.numpy as jnp
+
+    is_fault = jnp.asarray(is_fault)
+    index_orders = jnp.asarray(index_orders)
+    if is_fault.ndim == 1:
+        is_fault = jnp.broadcast_to(is_fault[None, :], index_orders.shape)
+    n = index_orders.shape[-1]
+    ordered_faults = jnp.take_along_axis(is_fault, index_orders, axis=-1)
+    ranks = jnp.arange(1, n + 1, dtype=jnp.float32)[None, :]
+    sum_of_fault_orders = jnp.sum(ordered_faults * ranks, axis=-1)
+    k = jnp.sum(is_fault, axis=-1)
+    return 1.0 - sum_of_fault_orders / (k * n) + 1.0 / (2.0 * n)

@@ -1,0 +1,154 @@
+"""Neuron-coverage criteria (NAC, KMNC, NBC, SNAC, TKNC).
+
+Behavioral contract matches the reference (reference: src/core/neuron_coverage.py):
+each criterion maps a badge of per-layer activations to ``(scores, profiles)``
+where ``profiles`` is a boolean coverage-bit array per sample and ``scores`` is
+the per-sample count of set bits.
+
+TPU-native design: all five criteria are pure elementwise/argsort programs over
+the flattened activation matrix ``(batch, neurons)``; under jit they fuse into
+the forward pass that produced the activations, so profile extraction is
+HBM-bandwidth-bound rather than a host round-trip. The class wrappers keep the
+reference's constructor surface (train-set mins/maxs/stds) so configuration and
+tests carry over 1:1.
+"""
+
+import abc
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from simple_tip_tpu.ops._backend import xp_for
+
+
+def sum_score(profiles) -> np.ndarray:
+    """Reduce a boolean profile array to per-sample counts of covered sections.
+
+    Chooses the smallest integer dtype that can hold the maximum possible
+    score (reference: src/core/neuron_coverage.py:8-22).
+    """
+    assert profiles.dtype == bool
+    xp = xp_for(profiles)
+    maxval = int(np.prod(profiles.shape[1:]))
+    if maxval <= np.iinfo(np.int16).max:
+        dtype = xp.int16
+    elif maxval <= np.iinfo(np.int32).max:
+        dtype = xp.int32
+    else:
+        dtype = xp.int64
+    score = xp.sum(profiles.reshape((profiles.shape[0], -1)), axis=1, dtype=dtype)
+    return score
+
+
+def flatten_layers(layers: Sequence) -> np.ndarray:
+    """Flatten a list of per-layer activation arrays to (batch, neurons)."""
+    xp = xp_for(layers[0])
+    flat = [xp.reshape(layer, (layer.shape[0], -1)) for layer in layers]
+    return xp.concatenate(flat, axis=1)
+
+
+def _flatten_1d(arrays: Sequence) -> np.ndarray:
+    """Concatenate per-layer statistics vectors into one flat neuron vector."""
+    xp = xp_for(arrays[0])
+    return xp.concatenate([xp.reshape(a, (-1,)) for a in arrays])
+
+
+class CoverageMethod(abc.ABC):
+    """Abstract neuron-coverage criterion: callable on a badge of activations."""
+
+    @abc.abstractmethod
+    def __call__(self, activations: List) -> Tuple[np.ndarray, np.ndarray]:
+        """Return (scores, profiles) for a badge of per-layer activations."""
+
+
+class NAC(CoverageMethod):
+    """Neuron-Activation Coverage: bit set where activation > threshold."""
+
+    def __init__(self, cov_threshold: float):
+        self.cov_threshold = cov_threshold
+
+    def __call__(self, activations: List) -> Tuple[np.ndarray, np.ndarray]:
+        acts = flatten_layers(activations)
+        profiles = acts > self.cov_threshold
+        return sum_score(profiles), profiles
+
+
+class KMNC(CoverageMethod):
+    """K-Multisection Neuron Coverage: which of k train-range buckets each
+    neuron's activation falls into (reference: src/core/neuron_coverage.py:65-94)."""
+
+    def __init__(self, mins: List, maxs: List, sections: int):
+        self.sections = sections
+        min_arr = _flatten_1d(mins)
+        max_arr = _flatten_1d(maxs)
+        jumps = (max_arr - min_arr) / sections
+        # Zero-width ranges (constant neurons, e.g. padded conv borders) simply
+        # yield never-set bits; harmless for coverage counting.
+        self.lo = min_arr
+        self.jumps = jumps
+
+    def __call__(self, activations: List) -> Tuple[np.ndarray, np.ndarray]:
+        acts = flatten_layers(activations)
+        xp = xp_for(acts)
+        # profiles: (batch, neurons, sections); bucket i covers
+        # [lo + i*jump, lo + (i+1)*jump)
+        edges = self.lo[None, :, None] + self.jumps[None, :, None] * xp.arange(
+            self.sections + 1
+        )
+        a = acts[:, :, None]
+        profiles = (edges[..., :-1] <= a) & (a < edges[..., 1:])
+        return sum_score(profiles), profiles
+
+
+class NBC(CoverageMethod):
+    """Neuron Boundary Coverage: activation outside [min - s*std, max + s*std]."""
+
+    def __init__(self, mins: List, maxs: List, stds: List, scaler: float):
+        min_arr = _flatten_1d(mins)
+        max_arr = _flatten_1d(maxs)
+        std_arr = _flatten_1d(stds)
+        self.min_boundaries = min_arr - scaler * std_arr
+        self.max_boundaries = max_arr + scaler * std_arr
+
+    def __call__(self, activations: List) -> Tuple[np.ndarray, np.ndarray]:
+        acts = flatten_layers(activations)
+        xp = xp_for(acts)
+        low = acts <= self.min_boundaries
+        high = acts >= self.max_boundaries
+        profiles = xp.stack([low, high], axis=-1)
+        return sum_score(profiles), profiles
+
+
+class SNAC(CoverageMethod):
+    """Strong Neuron Activation Coverage: activation >= max + s*std."""
+
+    def __init__(self, maxs: List, stds: List, scaler: float):
+        max_arr = _flatten_1d(maxs)
+        std_arr = _flatten_1d(stds)
+        self.max_boundaries = max_arr + scaler * std_arr
+
+    def __call__(self, activations: List) -> Tuple[np.ndarray, np.ndarray]:
+        acts = flatten_layers(activations)
+        profiles = acts >= self.max_boundaries
+        return sum_score(profiles), profiles
+
+
+class TKNC(CoverageMethod):
+    """Top-K Neuron Coverage: per layer, bit set for the k highest-activated
+    neurons of each sample (reference: src/core/neuron_coverage.py:147-167)."""
+
+    def __init__(self, top_neurons: int):
+        self.top_neurons = top_neurons
+
+    def __call__(self, activations: List) -> Tuple[np.ndarray, np.ndarray]:
+        xp = xp_for(activations[0])
+        profiles = []
+        for layer in activations:
+            layer = xp.reshape(layer, (layer.shape[0], -1))
+            # rank-of-each-element via double argsort (stable); exactly k bits
+            # per layer, matching the reference's put_along_axis on argsort.
+            order = xp.argsort(layer, axis=1)
+            ranks = xp.argsort(order, axis=1)
+            profiles.append(ranks >= layer.shape[1] - self.top_neurons)
+        flat = flatten_layers(profiles)
+        return sum_score(flat), flat

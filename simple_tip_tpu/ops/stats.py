@@ -1,0 +1,154 @@
+"""Streaming aggregate statistics (per-neuron min / max / std) over activation
+badges, with per-statistic timing.
+
+Replaces the reference's welford-package-backed collector (reference:
+src/dnn_test_prio/aggregate_statistics.py:12-67) with a self-contained Welford
+implementation. ``std`` is the sample standard deviation (ddof=1), matching
+``welford.Welford.var_s``.
+
+A fused jnp path (``aggregate_over_batches``) computes all three statistics for
+a whole dataset in one ``lax.scan`` on device — the preferred path for the
+coverage worker; the incremental host class remains for streaming use.
+"""
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from simple_tip_tpu.ops.timer import Timer
+
+AggStats = Tuple[List[np.ndarray], List[np.ndarray], List[np.ndarray]]
+
+
+class _Welford:
+    """Chan et al. parallel variance over batches of (batch, ...) arrays."""
+
+    def __init__(self):
+        self.count = 0
+        self.mean = None
+        self.m2 = None
+
+    def add_all(self, batch: np.ndarray):
+        batch = np.asarray(batch, dtype=np.float64)
+        b_count = batch.shape[0]
+        if b_count == 0:
+            return
+        b_mean = batch.mean(axis=0)
+        b_m2 = ((batch - b_mean) ** 2).sum(axis=0)
+        if self.count == 0:
+            self.count, self.mean, self.m2 = b_count, b_mean, b_m2
+            return
+        delta = b_mean - self.mean
+        total = self.count + b_count
+        self.mean = self.mean + delta * (b_count / total)
+        self.m2 = self.m2 + b_m2 + delta**2 * (self.count * b_count / total)
+        self.count = total
+
+    @property
+    def var_s(self) -> np.ndarray:
+        """Sample variance (ddof=1)."""
+        if self.count < 2:
+            return np.full_like(self.mean, np.nan)
+        return self.m2 / (self.count - 1)
+
+
+class AggregateStatisticsCollector:
+    """Streaming per-neuron min/max/std over per-layer activation badges,
+    timing each statistic separately (for the reference's per-metric setup-time
+    debit accounting, reference: src/dnn_test_prio/handler_coverage.py:49-101)."""
+
+    def __init__(self):
+        self.done = False
+        self.mins: List[np.ndarray] = []
+        self.maxs: List[np.ndarray] = []
+        self.welfords: List[_Welford] = []
+        self.min_timer = Timer()
+        self.max_timer = Timer()
+        self.welford_timer = Timer()
+
+    def track(self, badge: Sequence[np.ndarray]) -> None:
+        """Fold the next badge of per-layer activation arrays into the stats."""
+        if self.done:
+            raise RuntimeError(
+                "`get` has been called. calling it multiple times falsifies timer."
+            )
+        badge = [np.asarray(b) for b in badge]
+        if not self.mins:
+            self.mins = [np.full(b.shape[1:], np.inf) for b in badge]
+            self.maxs = [np.full(b.shape[1:], -np.inf) for b in badge]
+            self.welfords = [_Welford() for _ in badge]
+        with self.min_timer:
+            self.mins = [
+                np.minimum(self.mins[i], badge[i].min(axis=0))
+                for i in range(len(badge))
+            ]
+        with self.max_timer:
+            self.maxs = [
+                np.maximum(self.maxs[i], badge[i].max(axis=0))
+                for i in range(len(badge))
+            ]
+        with self.welford_timer:
+            for i in range(len(badge)):
+                self.welfords[i].add_all(badge[i].reshape(badge[i].shape[0], -1))
+
+    def get(self) -> AggStats:
+        """Return (mins, maxs, stds) per layer."""
+        with self.welford_timer:
+            stds = [
+                np.sqrt(w.var_s).reshape(self.mins[i].shape)
+                for i, w in enumerate(self.welfords)
+            ]
+        return self.mins, self.maxs, stds
+
+
+def aggregate_over_batches(layer_batches_iter):
+    """Fused device path: iterate (list-of-layer-arrays) badges, compute
+    min/max/Welford on device via jnp, return host numpy (mins, maxs, stds).
+
+    The per-badge update is a single fused XLA program per layer; the
+    sequential fold over badges stays in Python because badge count is tiny
+    compared to badge size.
+    """
+    import jax.numpy as jnp
+
+    state = None  # per-layer (min, max, count, mean, m2)
+    for badge in layer_batches_iter:
+        badge = [jnp.asarray(b) for b in badge]
+        if state is None:
+            state = []
+            for b in badge:
+                flat = b.reshape(b.shape[0], -1).astype(jnp.float32)
+                state.append(
+                    (
+                        b.min(axis=0),
+                        b.max(axis=0),
+                        b.shape[0],
+                        flat.mean(axis=0),
+                        ((flat - flat.mean(axis=0)) ** 2).sum(axis=0),
+                    )
+                )
+            continue
+        new_state = []
+        for (mn, mx, cnt, mean, m2), b in zip(state, badge):
+            flat = b.reshape(b.shape[0], -1).astype(jnp.float32)
+            b_cnt = b.shape[0]
+            b_mean = flat.mean(axis=0)
+            b_m2 = ((flat - b_mean) ** 2).sum(axis=0)
+            delta = b_mean - mean
+            total = cnt + b_cnt
+            new_state.append(
+                (
+                    jnp.minimum(mn, b.min(axis=0)),
+                    jnp.maximum(mx, b.max(axis=0)),
+                    total,
+                    mean + delta * (b_cnt / total),
+                    m2 + b_m2 + delta**2 * (cnt * b_cnt / total),
+                )
+            )
+        state = new_state
+    mins = [np.asarray(s[0]) for s in state]
+    maxs = [np.asarray(s[1]) for s in state]
+    stds = [
+        np.asarray(jnp.sqrt(s[4] / (s[2] - 1)).reshape(s[0].shape)) for s in state
+    ]
+    return mins, maxs, stds

@@ -1,0 +1,71 @@
+"""Wall-clock timing utilities.
+
+Mirrors the semantics of the reference's timer (reference: src/core/timer.py:6-50):
+re-entrant accumulation over start/stop segments, context-manager and decorator
+forms, RuntimeError on misuse and a RuntimeWarning when read while running.
+
+Adds ``device_timed`` for accurate on-device timing: JAX dispatch is async, so a
+naive wall-clock around a jitted call measures dispatch, not compute. We bracket
+with ``jax.block_until_ready`` on the outputs.
+"""
+
+import time
+import warnings
+
+
+class Timer:
+    """Accumulating wall-clock timer (start/stop, context manager, decorator)."""
+
+    def __init__(self, start: bool = False):
+        self._start_time = None
+        self._elapsed = 0.0
+        if start:
+            self.start()
+
+    def start(self):
+        """Start the timer; it must not already be running."""
+        if self._start_time is not None:
+            raise RuntimeError("Timer is already started")
+        self._start_time = time.time()
+
+    def stop(self):
+        """Stop the timer; it must be running."""
+        if self._start_time is None:
+            raise RuntimeError("Timer is not started")
+        self._elapsed += time.time() - self._start_time
+        self._start_time = None
+
+    def timed(self, f):
+        """Decorator: accumulate the wrapped function's wall-clock into this timer."""
+
+        def wrapper(*args, **kwargs):
+            with self:
+                return f(*args, **kwargs)
+
+        return wrapper
+
+    def get(self) -> float:
+        """Elapsed seconds over all completed segments (warns if still running)."""
+        if self._start_time is not None:
+            warnings.warn("Timer is not stopped", RuntimeWarning)
+        return self._elapsed
+
+    def __enter__(self):
+        self.start()
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+
+
+def device_timed(timer: Timer, fn, *args, **kwargs):
+    """Run ``fn`` and accumulate its wall-clock into ``timer``, blocking on the
+    returned JAX arrays so async dispatch does not fake the measurement."""
+    import jax
+
+    timer.start()
+    try:
+        out = fn(*args, **kwargs)
+        out = jax.block_until_ready(out)
+    finally:
+        timer.stop()
+    return out

@@ -1,0 +1,99 @@
+"""Softmax-based uncertainty quantifiers (point-prediction TIPs).
+
+The reference registers these as uncertainty-wizard quantifiers (reference:
+src/core/deepgini.py:12-40, src/dnn_test_prio/handler_model.py:106); here they
+are pure array functions. Each returns ``(predictions, uncertainty)``.
+
+Convention: all values are *uncertainties* (higher = more likely misclassified),
+matching the reference's ``predict_quantified(as_confidence=False)``, which
+negates confidence metrics (MaxSoftmax, PCS). All downstream consumers (APFD via
+descending argsort, active-learning top-k) depend only on the ordering.
+
+Functions dispatch on the input type: numpy in / numpy out (float64 exactness
+for oracle tests), jax in / jax out (for use inside jit). Artifact-name keys
+(matching the reference's file naming contract): ``softmax``, ``pcs``,
+``softmax_entropy``, ``deep_gini``, ``VR``.
+"""
+
+from typing import Tuple
+
+import numpy as np
+
+
+def _xp(a):
+    """Pick numpy or jax.numpy based on the input array's type."""
+    try:
+        import jax
+
+        if isinstance(a, jax.Array):
+            import jax.numpy as jnp
+
+            return jnp
+    except ImportError:  # pragma: no cover
+        pass
+    return np
+
+
+def max_softmax(probs) -> Tuple[np.ndarray, np.ndarray]:
+    """Vanilla softmax score: uncertainty = -max(softmax)."""
+    xp = _xp(probs)
+    pred = xp.argmax(probs, axis=1)
+    conf = xp.max(probs, axis=1)
+    return pred, -conf
+
+
+def pcs(probs) -> Tuple[np.ndarray, np.ndarray]:
+    """Prediction-confidence score: uncertainty = -(max - second_max)."""
+    xp = _xp(probs)
+    pred = xp.argmax(probs, axis=1)
+    top2 = xp.sort(probs, axis=1)[:, -2:]
+    conf = top2[:, 1] - top2[:, 0]
+    return pred, -conf
+
+
+def softmax_entropy(probs) -> Tuple[np.ndarray, np.ndarray]:
+    """Softmax entropy: -sum p log2 p (0 log 0 := 0)."""
+    xp = _xp(probs)
+    pred = xp.argmax(probs, axis=1)
+    logs = xp.where(probs > 0, xp.log2(xp.where(probs > 0, probs, 1.0)), 0.0)
+    entropy = -xp.sum(probs * logs, axis=1)
+    return pred, entropy
+
+
+def deep_gini(probs) -> Tuple[np.ndarray, np.ndarray]:
+    """DeepGini impurity: 1 - sum(softmax^2) (reference: src/core/deepgini.py:32-35)."""
+    xp = _xp(probs)
+    pred = xp.argmax(probs, axis=1)
+    gini = 1 - xp.sum(probs * probs, axis=1)
+    return pred, gini
+
+
+def variation_ratio(sampled_probs) -> Tuple[np.ndarray, np.ndarray]:
+    """MC-dropout variation ratio over stochastic forward samples.
+
+    ``sampled_probs``: (num_samples, batch, classes) softmax outputs from
+    stochastic forward passes. Per input: take each sample's argmax class,
+    VR = 1 - (votes for majority class) / num_samples; prediction = majority
+    class. Matches uncertainty-wizard's VariationRatio semantics
+    (reference: src/dnn_test_prio/handler_model.py:151-166).
+    """
+    xp = _xp(sampled_probs)
+    num_samples, _, num_classes = sampled_probs.shape
+    votes = xp.argmax(sampled_probs, axis=2)  # (S, B)
+    # One-hot count votes per class without data-dependent shapes.
+    one_hot = votes[..., None] == xp.arange(num_classes)[None, None, :]
+    counts = xp.sum(one_hot, axis=0)  # (B, C)
+    majority = xp.argmax(counts, axis=1)
+    majority_count = xp.max(counts, axis=1)
+    vr = 1.0 - majority_count / num_samples
+    return majority, vr
+
+
+# Registry keyed by artifact name (the reference's `uncertainty_{key}.npy`
+# naming, reference: src/dnn_test_prio/eval_prioritization.py:208-215).
+POINT_PRED_QUANTIFIERS = {
+    "softmax": max_softmax,
+    "pcs": pcs,
+    "softmax_entropy": softmax_entropy,
+    "deep_gini": deep_gini,
+}

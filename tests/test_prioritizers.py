@@ -1,0 +1,83 @@
+"""CTM/CAM oracle tests: the worked example from the DeepGini paper under 10
+shuffles (including the documented correction of the paper's own expected CAM
+order), plus a property/fuzz test on random boolean profiles.
+Mirrors the reference's tests/test_prioritizers.py."""
+
+import random
+from typing import List, Tuple
+
+import numpy as np
+import pytest
+
+from simple_tip_tpu.ops import prioritizers
+
+
+def get_example(seed) -> Tuple[np.ndarray, List[str]]:
+    """The example given in the DeepGini paper; a seed shuffles the entries
+    (order should not matter)."""
+    examples_from_paper = [
+        [True, True, True, False, False, True, True, True],
+        [True, True, True, False, False, False, True, True],
+        [True, True, True, True, False, False, False, False],
+        [False, False, False, False, True, True, True, True],
+    ]
+    re_indexes = ["A", "B", "C", "D"]
+    random.Random(seed).shuffle(examples_from_paper)
+    random.Random(seed).shuffle(re_indexes)
+    return np.array(examples_from_paper, dtype=bool), re_indexes
+
+
+@pytest.mark.parametrize("seed", [i for i in range(10)])
+def test_ctm(seed: int):
+    profile, idxs = get_example(seed=seed)
+    scores = np.sum(profile, axis=1)
+    predicted_order = [idxs[i] for i in prioritizers.ctm(scores)]
+    assert predicted_order in (["A", "B", "C", "D"], ["A", "B", "D", "C"])
+
+
+@pytest.mark.parametrize("seed", [i for i in range(10)])
+@pytest.mark.parametrize(
+    "shape", [(4, 8), (4, 8, 1), (4, 4, 2), (4, 2, 2, 2), (-1, 2, 4)]
+)
+def test_cam(seed: int, shape: Tuple[int]):
+    profile, idxs = get_example(seed=seed)
+    scores = np.sum(profile, axis=1)
+    profile = np.reshape(profile, shape)
+    predicted_order = [idxs[i] for i in prioritizers.cam(scores, profile)]
+    # The DeepGini paper mentions only ["A", "D", "C", "B"] as a valid solution,
+    # which is wrong (see the reference's test for the correction).
+    assert predicted_order in (["A", "D", "C", "B"], ["A", "C", "D", "B"])
+
+
+@pytest.mark.parametrize(
+    "seed, shape, prob",
+    [
+        (1, (20, 100), 0.1),
+        (1, (200, 1000), 0.0001),
+        (2, (2000, 10000), 0.01),
+    ],
+)
+def test_cam_fuzzer(seed: int, shape: Tuple[int], prob: float):
+    rng = np.random.RandomState(seed)
+    profile = rng.random(shape) < prob
+    scores = np.sum(profile, axis=1)
+
+    profiles_copy = profile.copy()
+    predicted_order = [i for i in prioritizers.cam(scores, profile)]
+
+    # Every sample yielded exactly once
+    assert sorted(predicted_order) == list(range(shape[0]))
+
+    covered_nodes = np.zeros(profile.shape[1], dtype=bool)
+    yielded_samples = np.zeros(profile.shape[0], dtype=bool)
+    last_coverage_increment = np.inf
+    previous_coverage_sum = 0
+    for i in predicted_order:
+        assert not yielded_samples[i]
+        yielded_samples[i] = True
+        covered_nodes = np.logical_or(covered_nodes, profiles_copy[i])
+        new_coverage_sum = np.sum(covered_nodes)
+        # Coverage-sum increments must be weakly monotonically decreasing
+        assert new_coverage_sum - previous_coverage_sum <= last_coverage_increment
+        last_coverage_increment = new_coverage_sum - previous_coverage_sum
+        previous_coverage_sum = new_coverage_sum

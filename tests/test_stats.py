@@ -1,0 +1,57 @@
+"""Streaming aggregate-statistics tests: the Welford min/max/std collector must
+match direct numpy computation over the concatenated badges."""
+
+import numpy as np
+import pytest
+
+from simple_tip_tpu.ops.stats import AggregateStatisticsCollector, aggregate_over_batches
+
+
+def _badges(rng, n_badges=5, badge=16):
+    shapes = [(3,), (2, 4)]
+    return [
+        [rng.standard_normal((badge,) + s).astype(np.float32) for s in shapes]
+        for _ in range(n_badges)
+    ]
+
+
+def test_collector_matches_numpy():
+    rng = np.random.default_rng(0)
+    badges = _badges(rng)
+    collector = AggregateStatisticsCollector()
+    for b in badges:
+        collector.track(b)
+    mins, maxs, stds = collector.get()
+
+    for i in range(2):
+        full = np.concatenate([b[i] for b in badges], axis=0)
+        np.testing.assert_allclose(mins[i], full.min(axis=0), rtol=1e-6)
+        np.testing.assert_allclose(maxs[i], full.max(axis=0), rtol=1e-6)
+        np.testing.assert_allclose(
+            stds[i],
+            full.reshape(full.shape[0], -1).std(axis=0, ddof=1).reshape(mins[i].shape),
+            rtol=1e-5,
+        )
+
+
+def test_collector_get_then_track_raises():
+    collector = AggregateStatisticsCollector()
+    collector.track([np.ones((4, 3))])
+    collector.get()
+    collector.done = True
+    with pytest.raises(RuntimeError):
+        collector.track([np.ones((4, 3))])
+
+
+def test_device_aggregate_matches_host():
+    rng = np.random.default_rng(1)
+    badges = _badges(rng)
+    mins_d, maxs_d, stds_d = aggregate_over_batches(iter(badges))
+    collector = AggregateStatisticsCollector()
+    for b in badges:
+        collector.track(b)
+    mins_h, maxs_h, stds_h = collector.get()
+    for i in range(2):
+        np.testing.assert_allclose(mins_d[i], mins_h[i], rtol=1e-5)
+        np.testing.assert_allclose(maxs_d[i], maxs_h[i], rtol=1e-5)
+        np.testing.assert_allclose(stds_d[i], stds_h[i], rtol=1e-3, atol=1e-5)

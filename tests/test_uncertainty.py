@@ -1,0 +1,79 @@
+"""Uncertainty-quantifier oracle tests.
+
+The DeepGini batch is the reference's hand-computed oracle
+(reference: tests/test_deepgini.py:15-38); the other quantifiers get
+order-consistency and closed-form checks.
+"""
+
+import numpy as np
+
+from simple_tip_tpu.ops.uncertainty import (
+    deep_gini,
+    max_softmax,
+    pcs,
+    softmax_entropy,
+    variation_ratio,
+)
+
+INPUT_BATCH = np.array(
+    [
+        [0.1, 0.2, 0.3, 0.4],
+        [0.5, 0.1, 0.1, 0.3],
+        [0.25, 0.25, 0.25, 0.25],
+        [1.0, 0, 0, 0],
+        [0, 1.0, 0, 0],
+    ]
+)
+
+
+def test_deep_gini_quantification():
+    pred, unc = deep_gini(INPUT_BATCH)
+    expected = np.array([0.7, 0.64, 0.75, 0, 0])
+    assert np.all(pred == np.array([3, 0, 0, 0, 1]))
+    assert np.all(unc == expected)
+
+
+def test_max_softmax():
+    pred, unc = max_softmax(INPUT_BATCH)
+    assert np.all(pred == np.array([3, 0, 0, 0, 1]))
+    np.testing.assert_allclose(unc, -np.array([0.4, 0.5, 0.25, 1.0, 1.0]))
+
+
+def test_pcs():
+    pred, unc = pcs(INPUT_BATCH)
+    assert np.all(pred == np.array([3, 0, 0, 0, 1]))
+    np.testing.assert_allclose(unc, -np.array([0.1, 0.2, 0.0, 1.0, 1.0]))
+
+
+def test_softmax_entropy():
+    _, unc = softmax_entropy(INPUT_BATCH)
+    # uniform distribution has maximal entropy (2 bits over 4 classes),
+    # one-hot has zero
+    np.testing.assert_allclose(unc[2], 2.0)
+    np.testing.assert_allclose(unc[3], 0.0)
+    np.testing.assert_allclose(unc[4], 0.0)
+    assert unc[0] > unc[1]
+
+
+def test_variation_ratio():
+    # 4 stochastic samples, 2 inputs, 3 classes
+    s = np.zeros((4, 2, 3))
+    # input 0: votes [0, 0, 0, 1] -> majority 0 with 3/4 -> vr = 0.25
+    s[0, 0, 0] = s[1, 0, 0] = s[2, 0, 0] = 1.0
+    s[3, 0, 1] = 1.0
+    # input 1: votes [2, 2, 2, 2] -> vr = 0
+    s[:, 1, 2] = 1.0
+    pred, vr = variation_ratio(s)
+    assert np.all(pred == np.array([0, 2]))
+    np.testing.assert_allclose(vr, np.array([0.25, 0.0]))
+
+
+def test_jax_path_matches_numpy():
+    import jax.numpy as jnp
+
+    probs = jnp.asarray(INPUT_BATCH, dtype=jnp.float32)
+    for fn in (deep_gini, max_softmax, pcs, softmax_entropy):
+        pred_j, unc_j = fn(probs)
+        pred_n, unc_n = fn(INPUT_BATCH)
+        assert np.all(np.asarray(pred_j) == pred_n)
+        np.testing.assert_allclose(np.asarray(unc_j), unc_n, rtol=1e-4, atol=1e-6)
