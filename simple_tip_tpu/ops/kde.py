@@ -1,0 +1,134 @@
+"""Numerically-stabilized Gaussian kernel density estimation (host, float64).
+
+Self-contained reimplementation of the math of scipy's ``gaussian_kde`` with the
+reference's stabilization semantics (reference: src/core/stable_kde.py:26-101):
+
+- Scott bandwidth factor ``n**(-1/(d+4))``.
+- While the scaled covariance has a non-positive eigenvalue, the data
+  covariance's *diagonal is replaced* by a doubling increment (1e-10, 2e-10,
+  ...); past ``MAX_INCREMENT=1e-5`` preparation fails silently and all
+  densities evaluate to 0. (The diagonal *replacement* — not addition — is a
+  quirk of the reference, preserved for behavioral parity.)
+- Cholesky of ``2*pi*covariance``; a failure surfaces the 1-based index of the
+  offending leading minor so LSA can drop that feature and retry
+  (reference: src/core/surprise.py:454-473).
+
+float64 throughout: TPUs have no native f64, and KDE fitting is a tiny
+(d<=300) host-side computation; only the *evaluation* over many test points is
+bulk work, implemented as a blocked float64 numpy quadform (still host — parity
+with scipy's float64 results matters more than device speed here, and APFD
+depends on score ordering which f32 exp underflow would distort).
+"""
+
+import warnings
+from typing import Optional
+
+import numpy as np
+import scipy.linalg
+
+
+class KDESingularError(np.linalg.LinAlgError):
+    """Cholesky failure carrying the 0-based index of the offending feature
+    (None if unknown)."""
+
+    def __init__(self, message: str, problematic_dim: Optional[int]):
+        super().__init__(message)
+        self.problematic_dim = problematic_dim
+
+
+class StableGaussianKDE:
+    """Gaussian KDE over a ``(d, n)`` float dataset with covariance
+    stabilization; mirrors scipy's gaussian_kde evaluation semantics."""
+
+    MAX_INCREMENT = 1e-5
+
+    def __init__(self, dataset: np.ndarray):
+        self.dataset = np.atleast_2d(np.asarray(dataset, dtype=np.float64))
+        self.d, self.n = self.dataset.shape
+        self.factor = self.scotts_factor()
+        self.prepare_failed = False
+        self._compute_covariance()
+
+    def scotts_factor(self) -> float:
+        """Scott's rule bandwidth factor."""
+        return np.power(self.n, -1.0 / (self.d + 4))
+
+    def _compute_covariance(self):
+        data_covariance = np.atleast_2d(np.cov(self.dataset, rowvar=1, bias=False))
+        data_covariance = self._stabilize_covariance(data_covariance)
+        if self.prepare_failed:
+            return
+        try:
+            data_inv_cov = np.linalg.inv(data_covariance)
+        except np.linalg.LinAlgError:
+            self.prepare_failed = True
+            return
+
+        self.covariance = data_covariance * self.factor**2
+        self.inv_cov = data_inv_cov / self.factor**2
+        # Cholesky of 2*pi*cov: raises with the offending leading-minor index
+        # (consumed by LSA's recursive feature drop).
+        try:
+            chol = scipy.linalg.cholesky(self.covariance * 2 * np.pi, lower=True)
+        except scipy.linalg.LinAlgError as e:
+            dim = None
+            msg = str(e)
+            if "leading minor" in msg:
+                try:
+                    dim = int(msg.split("-th")[0].strip().lstrip("(")) - 1
+                except ValueError:
+                    dim = None
+            raise KDESingularError(msg, dim) from e
+        self.cho_cov = chol
+        self.log_det = 2 * np.log(np.diag(chol)).sum()
+
+    def _stabilize_covariance(self, covariance: np.ndarray):
+        """Replace the diagonal with a doubling increment until the scaled
+        covariance is numerically positive definite, or fail silently."""
+        increment = 1e-10
+        while np.any(np.linalg.eigh(covariance * self.factor**2)[0] <= 0):
+            np.fill_diagonal(covariance, increment)
+            if increment > self.MAX_INCREMENT:
+                warnings.warn(
+                    "Was not able to fix numerical imprecision in covariance "
+                    "matrix. Failing silently. All likelihoods will be "
+                    "reported as 0."
+                )
+                self.prepare_failed = True
+                return None
+            increment += increment
+        self.prepare_failed = False
+        return covariance
+
+    def evaluate(self, points: np.ndarray) -> np.ndarray:
+        """Densities at ``points`` of shape ``(d, m)``; zeros if preparation
+        failed. Blocked whitened-distance evaluation, float64."""
+        points = np.atleast_2d(np.asarray(points, dtype=np.float64))
+        if self.prepare_failed:
+            return np.zeros(points.shape[1])
+        if points.shape[0] != self.d:
+            raise ValueError(
+                f"points have dimension {points.shape[0]}, dataset has {self.d}"
+            )
+        # Whiten with the cholesky of cov (not 2*pi*cov): solve L w = x.
+        chol = self.cho_cov / np.sqrt(2 * np.pi)
+        white_data = scipy.linalg.solve_triangular(chol, self.dataset, lower=True)
+        white_points = scipy.linalg.solve_triangular(chol, points, lower=True)
+        m = points.shape[1]
+        out = np.empty(m)
+        norm = np.exp(-0.5 * self.log_det) / self.n
+        d2_data = np.sum(white_data**2, axis=0)
+        block = max(1, int(2**22 // max(1, self.n)))
+        for start in range(0, m, block):
+            wp = white_points[:, start : start + block]
+            # squared whitened distances: |x|^2 + |y|^2 - 2 x.y
+            d2 = (
+                d2_data[None, :]
+                + np.sum(wp**2, axis=0)[:, None]
+                - 2.0 * (wp.T @ white_data)
+            )
+            np.maximum(d2, 0.0, out=d2)
+            out[start : start + block] = np.exp(-0.5 * d2).sum(axis=1) * norm
+        return out
+
+    __call__ = evaluate
