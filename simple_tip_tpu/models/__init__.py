@@ -1,0 +1,14 @@
+"""Flax models for the four case studies, with activation taps.
+
+Each model's ``__call__`` returns ``(softmax_probs, taps)`` where ``taps`` maps
+the *reference Keras layer index* to that layer's output (SURVEY.md section
+2.2 D10-D13). Returning all taps unconditionally is free under jit: XLA's dead
+code elimination prunes any tap the caller does not consume, so the same
+traced program serves plain prediction, NC profile extraction and SA AT
+collection.
+"""
+
+from simple_tip_tpu.models.convnet import Cifar10ConvNet, MnistConvNet
+from simple_tip_tpu.models.transformer import ImdbTransformer
+
+__all__ = ["MnistConvNet", "Cifar10ConvNet", "ImdbTransformer"]

@@ -1,0 +1,89 @@
+"""Convolutional models for the MNIST / Fashion-MNIST / CIFAR-10 case studies.
+
+Architectures match the reference's Keras models exactly (layer order, widths,
+activations, initialization family):
+
+- ``MnistConvNet``  (reference: src/dnn_test_prio/case_study_mnist.py:50-69):
+  Conv 32 3x3 relu -> MaxPool 2x2 -> Conv 64 3x3 relu -> MaxPool 2x2 ->
+  Flatten -> Dropout 0.5 -> Dense 10 softmax. Also used for Fashion-MNIST
+  (case_study_fashion_mnist.py:29-48).
+- ``Cifar10ConvNet`` (reference: src/dnn_test_prio/case_study_cifar10.py:33-57):
+  Conv 32 -> MaxPool -> Conv 64 -> MaxPool -> Conv 64 -> Flatten -> Dense 64
+  relu -> Dense 10 softmax. **No dropout** — MC-dropout (VR) is intentionally
+  unavailable on CIFAR-10, as in the reference.
+
+Tap indices follow the Keras ``model.layers`` numbering so the reference's
+``SA_ACTIVATION_LAYERS``/``NC_ACTIVATION_LAYERS`` configs carry over verbatim.
+"""
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+# Keras Conv2D/Dense default kernel initializer.
+glorot = nn.initializers.glorot_uniform()
+
+
+class MnistConvNet(nn.Module):
+    """LeNet-style convnet for MNIST/FMNIST; taps 0-3 are conv/pool outputs."""
+
+    num_classes: int = 10
+    dropout_rate: float = 0.5
+
+    has_dropout = True
+    # Keras layer indices usable as NC/SA taps.
+    sa_layers = (3,)
+    nc_layers = (0, 1, 2, 3)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[int, jnp.ndarray]]:
+        taps: Dict[int, jnp.ndarray] = {}
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", kernel_init=glorot)(x))
+        taps[0] = x
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        taps[1] = x
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", kernel_init=glorot)(x))
+        taps[2] = x
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        taps[3] = x
+        x = x.reshape((x.shape[0], -1))
+        taps[4] = x
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        taps[5] = x
+        logits = nn.Dense(self.num_classes, kernel_init=glorot)(x)
+        probs = nn.softmax(logits)
+        taps[6] = probs
+        return probs, taps
+
+
+class Cifar10ConvNet(nn.Module):
+    """3-conv CNN for CIFAR-10; no stochastic layers (VR intentionally absent)."""
+
+    num_classes: int = 10
+
+    has_dropout = False
+    sa_layers = (3,)
+    nc_layers = (0, 1, 2, 3)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[int, jnp.ndarray]]:
+        taps: Dict[int, jnp.ndarray] = {}
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", kernel_init=glorot)(x))
+        taps[0] = x
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        taps[1] = x
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", kernel_init=glorot)(x))
+        taps[2] = x
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        taps[3] = x
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", kernel_init=glorot)(x))
+        taps[4] = x
+        x = x.reshape((x.shape[0], -1))
+        taps[5] = x
+        x = nn.relu(nn.Dense(64, kernel_init=glorot)(x))
+        taps[6] = x
+        logits = nn.Dense(self.num_classes, kernel_init=glorot)(x)
+        probs = nn.softmax(logits)
+        taps[7] = probs
+        return probs, taps

@@ -1,0 +1,242 @@
+"""Training and inference loops (single-model path).
+
+Keras-`fit`-equivalent semantics (reference trains with
+``model.fit(x, y, batch_size, epochs, validation_split=0.1)``, e.g.
+src/dnn_test_prio/case_study_mnist.py:68):
+
+- validation_split takes the LAST fraction of the data *before* shuffling;
+  the remaining head is the training set, reshuffled every epoch.
+- categorical cross-entropy on softmax outputs with keras' 1e-7 clipping.
+- Adam with keras defaults (lr 1e-3, eps 1e-7).
+- the final partial batch contributes a smaller-denominator mean.
+
+TPU-native structure: one jitted epoch = ``lax.scan`` over per-batch gather +
+train step (static shapes; the ragged final batch is padded and masked, which
+reproduces keras' semantics exactly while keeping XLA happy). The epoch
+function is pure in (params, opt_state, rng), so the ensemble layer can vmap
+it over a stacked parameter axis without modification.
+"""
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Hyperparameters of one keras-`fit`-equivalent training run."""
+
+    batch_size: int = 128
+    epochs: int = 15
+    learning_rate: float = 1e-3
+    validation_split: float = 0.1
+
+
+def adam_like_keras(learning_rate: float = 1e-3) -> optax.GradientTransformation:
+    """Adam with tf.keras defaults (eps=1e-7 instead of optax's 1e-8)."""
+    return optax.adam(learning_rate, b1=0.9, b2=0.999, eps=1e-7)
+
+
+def categorical_crossentropy(probs: jnp.ndarray, y_onehot: jnp.ndarray) -> jnp.ndarray:
+    """Per-sample keras categorical cross-entropy on softmax outputs."""
+    p = jnp.clip(probs, 1e-7, 1.0)
+    return -jnp.sum(y_onehot * jnp.log(p), axis=-1)
+
+
+def _epoch_plan(n_train: int, batch_size: int) -> Tuple[int, int]:
+    steps = math.ceil(n_train / batch_size)
+    return steps, steps * batch_size
+
+
+def make_epoch_fn(
+    model, tx: optax.GradientTransformation, batch_size: int
+) -> Callable:
+    """Build the jitted one-epoch function ``(params, opt_state, x, y, rng) ->
+    (params, opt_state, mean_loss)``.
+
+    ``x``/``y_onehot`` are full (device-resident) training arrays; each scan
+    step gathers its shuffled batch by index. Pure in its arguments — safe to
+    vmap over a leading ensemble axis.
+    """
+
+    def loss_fn(params, xb, yb, mask, dropout_rng):
+        probs, _ = model.apply(
+            {"params": params}, xb, train=True, rngs={"dropout": dropout_rng}
+        )
+        losses = categorical_crossentropy(probs, yb)
+        return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def epoch_fn(params, opt_state, x, y_onehot, rng):
+        n_train = x.shape[0]
+        steps, padded = _epoch_plan(n_train, batch_size)
+        perm_rng, dropout_rng = jax.random.split(rng)
+        perm = jax.random.permutation(perm_rng, n_train)
+        idx = jnp.concatenate([perm, jnp.zeros(padded - n_train, perm.dtype)])
+        mask = (jnp.arange(padded) < n_train).astype(jnp.float32)
+        idx = idx.reshape(steps, batch_size)
+        mask = mask.reshape(steps, batch_size)
+        step_rngs = jax.random.split(dropout_rng, steps)
+
+        def step(carry, sl):
+            params, opt_state = carry
+            batch_idx, batch_mask, step_rng = sl
+            xb = jnp.take(x, batch_idx, axis=0)
+            yb = jnp.take(y_onehot, batch_idx, axis=0)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, xb, yb, batch_mask, step_rng
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), (idx, mask, step_rngs)
+        )
+        return params, opt_state, jnp.mean(losses)
+
+    return epoch_fn
+
+
+def init_params(model, rng, example_x) -> Any:
+    """Initialize model parameters for an example input batch."""
+    variables = model.init({"params": rng, "dropout": rng}, example_x, train=False)
+    return variables["params"]
+
+
+def train_model(
+    model,
+    x: np.ndarray,
+    y_onehot: np.ndarray,
+    cfg: TrainConfig,
+    rng: jax.Array,
+    verbose: bool = False,
+) -> Any:
+    """Train a fresh model, returning its parameters.
+
+    Replicates ``model.fit(x, y, batch_size, epochs, validation_split)``: the
+    last ``validation_split`` fraction is held out (not used for anything but
+    parity of the effective training set), the head is shuffled per epoch.
+    """
+    n = x.shape[0]
+    n_train = n - int(n * cfg.validation_split)
+    x_train = jnp.asarray(x[:n_train])
+    y_train = jnp.asarray(y_onehot[:n_train])
+
+    init_rng, epoch_rng = jax.random.split(rng)
+    params = init_params(model, init_rng, x_train[:1])
+    tx = adam_like_keras(cfg.learning_rate)
+    opt_state = tx.init(params)
+    epoch_fn = make_epoch_fn(model, tx, cfg.batch_size)
+
+    for epoch in range(cfg.epochs):
+        epoch_rng, this_rng = jax.random.split(epoch_rng)
+        params, opt_state, loss = epoch_fn(params, opt_state, x_train, y_train, this_rng)
+        if verbose:
+            print(f"epoch {epoch + 1}/{cfg.epochs} loss={float(loss):.4f}")
+    return params
+
+
+def make_predict_fn(model, batch_size: int = 1024) -> Callable:
+    """Batched deterministic forward: ``(params, x) -> probs`` (host numpy).
+
+    Pads the ragged final batch; the jitted program is traced once per input
+    shape class."""
+
+    @jax.jit
+    def fwd(params, xb):
+        probs, _ = model.apply({"params": params}, xb, train=False)
+        return probs
+
+    def predict(params, x: np.ndarray) -> np.ndarray:
+        outs = []
+        for start in range(0, x.shape[0], batch_size):
+            xb = jnp.asarray(x[start : start + batch_size])
+            outs.append(np.asarray(fwd(params, xb)))
+        return np.concatenate(outs, axis=0)
+
+    return predict
+
+
+def make_taps_fn(
+    model, activation_layers, include_last_layer: bool = False, batch_size: int = 1024
+) -> Callable:
+    """Batched transparent forward returning the tapped layer outputs.
+
+    Equivalent of the reference's "transparent model"
+    (reference: src/dnn_test_prio/handler_model.py:175-206): selects taps whose
+    Keras layer index is in ``activation_layers`` (integers only — tuple
+    entries are silently ignored, replicating handler_model.py:202), plus the
+    final output if requested. Unconsumed taps are DCE'd by XLA.
+    """
+    layer_ids = [i for i in activation_layers if isinstance(i, int)]
+
+    @jax.jit
+    def fwd(params, xb):
+        probs, taps = model.apply({"params": params}, xb, train=False)
+        outs = [taps[i] for i in layer_ids]
+        if include_last_layer:
+            outs.append(probs)
+        return outs
+
+    def get_activations(params, x: np.ndarray):
+        n = x.shape[0]
+        chunks = []
+        for start in range(0, n, batch_size):
+            xb = jnp.asarray(x[start : start + batch_size])
+            chunks.append([np.asarray(o) for o in fwd(params, xb)])
+        return [np.concatenate([c[i] for c in chunks], axis=0) for i in range(len(chunks[0]))]
+
+    return get_activations
+
+
+def evaluate_accuracy(model, params, x: np.ndarray, labels: np.ndarray, batch_size: int = 1024) -> float:
+    """Top-1 accuracy of the model on (x, labels)."""
+    predict = make_predict_fn(model, batch_size)
+    probs = predict(params, x)
+    return float(np.mean(np.argmax(probs, axis=1) == np.asarray(labels).flatten()))
+
+
+def mc_dropout_votes(
+    model, params, x: np.ndarray, n_samples: int, rng, batch_size: int = 256
+) -> np.ndarray:
+    """Class-vote counts over stochastic (dropout-active) forward passes.
+
+    Used for the variation-ratio quantifier with DROPOUT_SAMPLE_SIZE samples
+    (reference: src/dnn_test_prio/handler_model.py:7,151-161). The sample loop
+    is a ``lax.scan`` accumulating one-hot argmax votes, so peak memory is one
+    batch of activations regardless of sample count.
+    """
+
+    @jax.jit
+    def votes_fn(params, xb, rngs):
+        def one_sample(counts, sample_rng):
+            probs, _ = model.apply(
+                {"params": params}, xb, train=True, rngs={"dropout": sample_rng}
+            )
+            votes = jnp.argmax(probs, axis=1)
+            one_hot = jax.nn.one_hot(votes, probs.shape[1], dtype=jnp.int32)
+            return counts + one_hot, None
+
+        init = jnp.zeros((xb.shape[0], _num_classes(model)), dtype=jnp.int32)
+        counts, _ = jax.lax.scan(one_sample, init, rngs)
+        return counts
+
+    n = x.shape[0]
+    out = []
+    for i, start in enumerate(range(0, n, batch_size)):
+        chunk_rng = jax.random.fold_in(rng, i)
+        rngs = jax.random.split(chunk_rng, n_samples)
+        xb = jnp.asarray(x[start : start + batch_size])
+        out.append(np.asarray(votes_fn(params, xb, rngs)))
+    return np.concatenate(out, axis=0)
+
+
+def _num_classes(model) -> int:
+    return getattr(model, "num_classes", 10)

@@ -1,0 +1,110 @@
+"""IMDB sentiment model: a small transformer (NOT an LSTM — see SURVEY.md
+section 2.2 D13 note), matching the reference's Keras architecture
+(reference: src/dnn_test_prio/case_study_imdb.py:48-182):
+
+token+position embedding (vocab 2000, maxlen 100, dim 32) -> TransformerBlock
+(MHA 2 heads with per-head key dim 32, FFN 32, dropout 0.1, post-LN) ->
+GlobalAveragePooling1D -> Dropout 0.1 -> Dense 20 relu -> Dropout 0.1 ->
+Dense 2 softmax.
+
+Tap indices follow the Keras functional ``model.layers`` numbering
+(0=input ... 7=softmax). The reference's NC config lists tuple-form taps into
+embedding/FFN sublayers which its own membership test silently ignores
+(handler_model.py:202 vs case_study_imdb.py:35-38); we replicate the
+*effective* behavior: only integer taps 3 and 5 participate in NC.
+"""
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+glorot = nn.initializers.glorot_uniform()
+
+
+def _keras_uniform(key, shape, dtype=jnp.float32):
+    """Keras Embedding default initializer: U(-0.05, 0.05)."""
+    return jax.random.uniform(key, shape, dtype, -0.05, 0.05)
+
+
+class TokenAndPositionEmbedding(nn.Module):
+    """Token embedding + learned position embedding (added)."""
+
+    maxlen: int
+    vocab_size: int
+    embed_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        positions = jnp.arange(x.shape[-1])
+        tok = nn.Embed(self.vocab_size, self.embed_dim, embedding_init=_keras_uniform)(
+            x.astype(jnp.int32)
+        )
+        pos = nn.Embed(self.maxlen, self.embed_dim, embedding_init=_keras_uniform)(
+            positions
+        )
+        return tok + pos
+
+
+class TransformerBlock(nn.Module):
+    """Post-LN transformer encoder block, Keras-tutorial style."""
+
+    embed_dim: int
+    num_heads: int
+    ff_dim: int
+    rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # Keras MultiHeadAttention(key_dim=embed_dim) uses *per-head* dim
+        # embed_dim => total qkv features = num_heads * embed_dim.
+        attn = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads,
+            qkv_features=self.num_heads * self.embed_dim,
+            out_features=self.embed_dim,
+            kernel_init=glorot,
+        )(x, x)
+        attn = nn.Dropout(self.rate, deterministic=not train)(attn)
+        out1 = nn.LayerNorm(epsilon=1e-6)(x + attn)
+        ffn = nn.Dense(self.ff_dim, kernel_init=glorot)(out1)
+        ffn = nn.relu(ffn)
+        ffn = nn.Dense(self.embed_dim, kernel_init=glorot)(ffn)
+        ffn = nn.Dropout(self.rate, deterministic=not train)(ffn)
+        return nn.LayerNorm(epsilon=1e-6)(out1 + ffn)
+
+
+class ImdbTransformer(nn.Module):
+    """2-class IMDB sentiment classifier with Keras-index taps."""
+
+    vocab_size: int = 2000
+    maxlen: int = 100
+    embed_dim: int = 32
+    num_heads: int = 2
+    ff_dim: int = 32
+    num_classes: int = 2
+
+    has_dropout = True
+    sa_layers = (5,)
+    # Effective reference behavior: tuple-form entries ignored, ints kept.
+    nc_layers = (3, 5)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[int, jnp.ndarray]]:
+        taps: Dict[int, jnp.ndarray] = {}
+        h = TokenAndPositionEmbedding(self.maxlen, self.vocab_size, self.embed_dim)(x)
+        taps[1] = h
+        h = TransformerBlock(self.embed_dim, self.num_heads, self.ff_dim)(h, train)
+        taps[2] = h
+        h = jnp.mean(h, axis=1)  # GlobalAveragePooling1D
+        taps[3] = h
+        h = nn.Dropout(0.1, deterministic=not train)(h)
+        taps[4] = h
+        h = nn.relu(nn.Dense(20, kernel_init=glorot)(h))
+        taps[5] = h
+        h = nn.Dropout(0.1, deterministic=not train)(h)
+        taps[6] = h
+        logits = nn.Dense(self.num_classes, kernel_init=glorot)(h)
+        probs = nn.softmax(logits)
+        taps[7] = probs
+        return probs, taps
