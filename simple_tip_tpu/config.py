@@ -1,0 +1,40 @@
+"""Global configuration: the filesystem artifact bus.
+
+The reference hardcodes ``OUTPUT_FOLDER = "/assets/"``
+(reference: src/dnn_test_prio/case_study.py:10) as a mounted volume; here the
+root is configurable via the ``TIP_ASSETS`` environment variable (default
+``./assets``) and all artifact paths are constructed through helpers so the
+*naming contract* — which the result aggregation layer parses by splitting on
+underscores — lives in exactly one place.
+
+The bus layout (SURVEY.md section 1, "storage bus"):
+
+- ``priorities/{cs}_{ds}_{model}_{type}.npy``   scores / orders / masks
+- ``times/{cs}_{ds}_{model}_{metric}``          pickled [setup, pred, quant, cam]
+- ``active_learning/{cs}_{model}_{metric}_{oodnom}.pickle``
+- ``models/{cs}/``                              per-run checkpoints
+- ``results/``                                  tables and plots
+- ``activations/{cs}/model_{id}/{ds}/layer_{i}/badge_{j}.npy``
+"""
+
+import os
+
+
+def output_folder() -> str:
+    """Root of the filesystem artifact bus."""
+    return os.environ.get("TIP_ASSETS", os.path.join(os.getcwd(), "assets"))
+
+
+def data_folder() -> str:
+    """Directory with raw dataset files (npy/npz caches)."""
+    return os.environ.get("TIP_DATA_DIR", os.path.join(os.getcwd(), "datasets"))
+
+
+def subdir(name: str) -> str:
+    """Path of (and ensure) an artifact-bus subdirectory."""
+    path = os.path.join(output_folder(), name)
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+MAX_NUM_MODELS = 100

@@ -1,0 +1,24 @@
+"""Dataset loading for the four case studies.
+
+The reference pulls MNIST/CIFAR-10 via keras, MNIST-C via tfds, CIFAR-10-C
+from a user-downloaded Zenodo tar, fmnist-C from shipped npy files and IMDB
+via HuggingFace datasets (SURVEY.md section 2.2 D10-D13). This build runs in
+environments with no network egress, so every loader:
+
+1. looks for cached arrays under ``TIP_DATA_DIR`` (same npy naming as the
+   reference where one exists: ``mnist_c_images.npy`` etc.);
+2. otherwise falls back to a *deterministic synthetic stand-in* with identical
+   shapes/dtypes/class structure (loudly warned) so every pipeline phase runs
+   end-to-end anywhere. Synthetic sets are learnable-but-not-trivial:
+   class-dependent spatial/token patterns plus noise, with a corrupted OOD
+   variant at a fixed severity.
+"""
+
+from simple_tip_tpu.data.loaders import (
+    load_cifar10,
+    load_fmnist,
+    load_imdb,
+    load_mnist,
+)
+
+__all__ = ["load_mnist", "load_fmnist", "load_cifar10", "load_imdb"]

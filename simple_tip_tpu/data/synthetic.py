@@ -1,0 +1,103 @@
+"""Deterministic synthetic stand-in datasets (zero-egress fallback).
+
+Shapes, dtypes, value ranges and class structure match the real datasets; the
+signal is class-dependent so the case-study models actually learn (accuracy
+well above chance), which keeps misclassification masks, uncertainty orderings
+and the active-learning deltas meaningful for framework validation and
+benchmarking. NOT a substitute for the real data when reproducing paper
+numbers — loaders warn loudly when falling back here.
+"""
+
+from typing import Tuple
+
+import numpy as np
+
+
+def image_classification(
+    seed: int,
+    n_train: int,
+    n_test: int,
+    shape: Tuple[int, int, int],
+    num_classes: int = 10,
+    noise: float = 0.25,
+):
+    """Class-stamped noisy images in [0,1], uint8-quantized like real data."""
+    rng = np.random.default_rng(seed)
+    h, w, c = shape
+
+    # Per-class fixed random template with localized high-intensity stamp.
+    templates = rng.uniform(0.0, 0.4, size=(num_classes, h, w, c))
+    for cls in range(num_classes):
+        r = (cls * 7919) % (h - 8)
+        col = (cls * 104729) % (w - 8)
+        templates[cls, r : r + 8, col : col + 8, :] += 0.55
+
+    def make(n, rng):
+        labels = rng.integers(0, num_classes, size=n)
+        x = templates[labels] + rng.normal(0, noise, size=(n, h, w, c))
+        x = np.clip(x, 0, 1)
+        # quantize like uint8-sourced data
+        x = np.round(x * 255).astype(np.uint8).astype(np.float32) / 255.0
+        return x, labels.astype(np.int64)
+
+    x_train, y_train = make(n_train, rng)
+    x_test, y_test = make(n_test, rng)
+    return (x_train, y_train), (x_test, y_test)
+
+
+def corrupt_images(x: np.ndarray, seed: int, severity: float = 0.5) -> np.ndarray:
+    """Synthetic corruption: mixture of additive noise, contrast loss and
+    translation — a stand-in for the *-C corruption benchmarks."""
+    rng = np.random.default_rng(seed)
+    out = x.copy()
+    n = x.shape[0]
+    kinds = rng.integers(0, 3, size=n)
+    # additive noise
+    idx = np.where(kinds == 0)[0]
+    out[idx] = np.clip(out[idx] + rng.normal(0, severity * 0.5, out[idx].shape), 0, 1)
+    # contrast loss towards mean
+    idx = np.where(kinds == 1)[0]
+    out[idx] = out[idx] * (1 - severity) + out[idx].mean() * severity
+    # translation (roll)
+    idx = np.where(kinds == 2)[0]
+    shift = max(1, int(severity * 6))
+    out[idx] = np.roll(out[idx], shift, axis=1)
+    return out.astype(np.float32)
+
+
+def token_classification(
+    seed: int,
+    n_train: int,
+    n_test: int,
+    maxlen: int = 100,
+    vocab_size: int = 2000,
+    num_classes: int = 2,
+):
+    """Synthetic token sequences with class-dependent token distributions
+    (IMDB stand-in): each class over-samples a disjoint vocabulary band."""
+    rng = np.random.default_rng(seed)
+
+    def make(n, rng):
+        labels = rng.integers(0, num_classes, size=n)
+        x = rng.integers(1, vocab_size, size=(n, maxlen))
+        for cls in range(num_classes):
+            idx = np.where(labels == cls)[0]
+            band_lo = 100 + cls * 300
+            # ~30% of positions drawn from the class band
+            mask = rng.random((idx.shape[0], maxlen)) < 0.3
+            band_tokens = rng.integers(band_lo, band_lo + 300, size=(idx.shape[0], maxlen))
+            x[idx] = np.where(mask, band_tokens, x[idx])
+        return x.astype(np.int32), labels.astype(np.int64)
+
+    x_train, y_train = make(n_train, rng)
+    x_test, y_test = make(n_test, rng)
+    return (x_train, y_train), (x_test, y_test)
+
+
+def corrupt_tokens(x: np.ndarray, seed: int, severity: float = 0.5, vocab_size: int = 2000) -> np.ndarray:
+    """Token-level corruption: random token replacement at the given rate
+    (stand-in for the thesaurus-corrupted IMDB set)."""
+    rng = np.random.default_rng(seed)
+    mask = rng.random(x.shape) < severity * 0.4
+    noise = rng.integers(1, vocab_size, size=x.shape)
+    return np.where(mask, noise, x).astype(x.dtype)

@@ -1,0 +1,6 @@
+"""Experiment engines: model handler, coverage/surprise workers, and the
+prioritization / active-learning / activation-collection phases.
+
+TPU-native counterpart of the reference's ``src/dnn_test_prio/`` (SURVEY.md
+section 2.2), writing the identical filesystem artifact contract.
+"""

@@ -1,0 +1,60 @@
+"""Activation-trace dump utility (the reference's post-paper ``at_collection``
+phase, reference: src/dnn_test_prio/activation_persistor.py): every tapped
+layer's activations plus labels, in badges of 100, to
+``activations/{cs}/model_{id}/{ds}/layer_{i}/badge_{j}.npy``.
+
+Warning from the reference applies here too: the full dump across all
+models/datasets is *multiple terabytes*.
+"""
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+from simple_tip_tpu.config import output_folder
+from simple_tip_tpu.engine.model_handler import BaseModel
+
+BADGE_SIZE = 100
+
+
+def _persist_badge(case_study, model_id, dataset, badge_id, activations, labels):
+    path = os.path.join(
+        output_folder(), "activations", case_study, f"model_{model_id}", dataset
+    )
+    for layer_i, layer_at in enumerate(activations):
+        folder = os.path.join(path, f"layer_{layer_i}")
+        os.makedirs(folder, exist_ok=True)
+        np.save(os.path.join(folder, f"badge_{badge_id}.npy"), layer_at)
+    labels_folder = os.path.join(path, "labels")
+    os.makedirs(labels_folder, exist_ok=True)
+    np.save(os.path.join(labels_folder, f"badge_{badge_id}.npy"), labels)
+
+
+def persist(
+    model_def,
+    params,
+    case_study: str,
+    model_id: int,
+    train_set: Tuple[np.ndarray, np.ndarray],
+    test_nominal: Tuple[np.ndarray, np.ndarray],
+    test_corrupted: Tuple[np.ndarray, np.ndarray],
+) -> None:
+    """Persist all layer activations of the model for the three datasets."""
+    transparent_model = BaseModel(
+        model_def,
+        params,
+        activation_layers=list(model_def.all_layers),
+        include_last_layer=False,
+        batch_size=BADGE_SIZE,
+    )
+    for ds, (x, y) in {
+        "train": train_set,
+        "test_nominal": test_nominal,
+        "test_nominal_and_corrupted": test_corrupted,
+    }.items():
+        for badge_id, start in enumerate(range(0, x.shape[0], BADGE_SIZE)):
+            badge_x = x[start : start + BADGE_SIZE]
+            badge_y = y[start : start + BADGE_SIZE]
+            activations = transparent_model.get_activations(badge_x)
+            _persist_badge(case_study, model_id, ds, badge_id, activations, badge_y)

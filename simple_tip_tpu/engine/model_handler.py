@@ -1,0 +1,138 @@
+"""Model-centric utilities: predictions + uncertainties, activation walking.
+
+TPU-native counterpart of the reference's ``BaseModel``
+(reference: src/dnn_test_prio/handler_model.py:88-206). Differences by design:
+
+- A model here is ``(flax module, params)``; the "transparent model" is not a
+  separately-built graph but the same traced program with taps consumed
+  (XLA DCE prunes the rest), see models/train.make_taps_fn.
+- MC-dropout variation ratio runs DROPOUT_SAMPLE_SIZE stochastic passes as a
+  ``lax.scan`` on device instead of 200 separate predict calls.
+- Timing keeps the reference's record semantics: per-quantifier
+  ``[setup, pred, quant, cam]`` with prediction time measured once and shared.
+"""
+
+import logging
+from typing import Dict, Generator, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from simple_tip_tpu.models.train import make_predict_fn, make_taps_fn, mc_dropout_votes
+from simple_tip_tpu.ops.timer import Timer
+from simple_tip_tpu.ops.uncertainty import POINT_PRED_QUANTIFIERS
+
+DROPOUT_SAMPLE_SIZE = 200
+
+logger = logging.getLogger(__name__)
+
+
+class BaseModel:
+    """Wraps (module, params) with prediction, uncertainty and AT utilities."""
+
+    def __init__(
+        self,
+        model_def,
+        params,
+        activation_layers: Optional[List] = None,
+        include_last_layer: bool = False,
+        batch_size: int = 32,
+    ):
+        self.model_def = model_def
+        self.params = params
+        self.activation_layers = activation_layers
+        self.include_last_layer = include_last_layer
+        self.batch_size = batch_size
+        self._predict_fn = None
+        self._taps_fn = None
+
+    # -- prediction + uncertainty --------------------------------------------
+
+    def get_pred_and_uncertainty(
+        self, x: np.ndarray, rng=None
+    ) -> Tuple[np.ndarray, Dict[str, np.ndarray], Dict[str, List[float]]]:
+        """Point predictions plus all applicable uncertainty quantifications.
+
+        Returns ``(pred, {name: uncertainty}, {name: [setup, pred, quant, cam]})``
+        with names matching the artifact contract: softmax, pcs,
+        softmax_entropy, deep_gini, and VR when the model has dropout layers.
+        """
+        if self._predict_fn is None:
+            self._predict_fn = make_predict_fn(self.model_def, self.batch_size)
+
+        pred_timer = Timer()
+        with pred_timer:
+            probs = self._predict_fn(self.params, x)
+            probs = np.asarray(probs)
+        pred_time = pred_timer.get()
+
+        uncertainties: Dict[str, np.ndarray] = {}
+        times: Dict[str, List[float]] = {}
+        pred = None
+        for name, quantifier in POINT_PRED_QUANTIFIERS.items():
+            q_timer = Timer()
+            with q_timer:
+                q_pred, unc = quantifier(probs)
+            if pred is None:
+                pred = np.asarray(q_pred)
+            uncertainties[name] = np.asarray(unc)
+            times[name] = [0, pred_time, q_timer.get(), 0]
+
+        if getattr(self.model_def, "has_dropout", False):
+            logger.info("Collecting MC-Dropout samples")
+            if rng is None:
+                rng = jax.random.PRNGKey(0)
+            sampling_timer = Timer()
+            with sampling_timer:
+                counts = mc_dropout_votes(
+                    self.model_def,
+                    self.params,
+                    x,
+                    n_samples=DROPOUT_SAMPLE_SIZE,
+                    rng=rng,
+                    batch_size=max(self.batch_size, 128),
+                )
+            quant_timer = Timer()
+            with quant_timer:
+                majority_count = counts.max(axis=1)
+                vr = 1.0 - majority_count / DROPOUT_SAMPLE_SIZE
+            uncertainties["VR"] = vr
+            times["VR"] = [
+                0,
+                sampling_timer.get(),
+                quant_timer.get(),
+                0,
+            ]
+        else:
+            logger.warning(
+                "No stochastic layers found in model. Skipping stochastic quantifiers."
+            )
+
+        return pred, uncertainties, times
+
+    # -- activations ---------------------------------------------------------
+
+    def _ensure_taps_fn(self):
+        if self._taps_fn is None:
+            if self.activation_layers is None:
+                raise ValueError("No activation layers specified")
+            self._taps_fn = make_taps_fn(
+                self.model_def,
+                self.activation_layers,
+                include_last_layer=self.include_last_layer,
+                batch_size=self.batch_size,
+            )
+
+    def get_activations(self, x: np.ndarray) -> List[np.ndarray]:
+        """Deterministic forward returning the tapped layer activations."""
+        self._ensure_taps_fn()
+        return self._taps_fn(self.params, x)
+
+    def walk_activations(
+        self, x: np.ndarray, badge_size: Optional[int] = None
+    ) -> Generator[List[np.ndarray], None, None]:
+        """Stream activations badge-by-badge over a potentially large dataset."""
+        self._ensure_taps_fn()
+        badge_size = badge_size or self.batch_size
+        for start in range(0, x.shape[0], badge_size):
+            yield self._taps_fn(self.params, x[start : start + badge_size])

@@ -35,6 +35,7 @@ class MnistConvNet(nn.Module):
     # Keras layer indices usable as NC/SA taps.
     sa_layers = (3,)
     nc_layers = (0, 1, 2, 3)
+    all_layers = (0, 1, 2, 3, 4, 5, 6)
 
     @nn.compact
     def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[int, jnp.ndarray]]:
@@ -65,6 +66,7 @@ class Cifar10ConvNet(nn.Module):
     has_dropout = False
     sa_layers = (3,)
     nc_layers = (0, 1, 2, 3)
+    all_layers = (0, 1, 2, 3, 4, 5, 6, 7)
 
     @nn.compact
     def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[int, jnp.ndarray]]:

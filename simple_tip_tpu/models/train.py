@@ -54,15 +54,16 @@ def _epoch_plan(n_train: int, batch_size: int) -> Tuple[int, int]:
     return steps, steps * batch_size
 
 
-def make_epoch_fn(
+def make_epoch_core(
     model, tx: optax.GradientTransformation, batch_size: int
 ) -> Callable:
-    """Build the jitted one-epoch function ``(params, opt_state, x, y, rng) ->
-    (params, opt_state, mean_loss)``.
+    """Build the *un-jitted* one-epoch function ``(params, opt_state, x, y,
+    rng) -> (params, opt_state, mean_loss)``.
 
     ``x``/``y_onehot`` are full (device-resident) training arrays; each scan
-    step gathers its shuffled batch by index. Pure in its arguments — safe to
-    vmap over a leading ensemble axis.
+    step gathers its shuffled batch by index. Pure in its arguments — the
+    single-model path jits it directly; the ensemble layer vmaps it over a
+    stacked parameter axis first (parallel/ensemble.py).
     """
 
     def loss_fn(params, xb, yb, mask, dropout_rng):
@@ -72,7 +73,6 @@ def make_epoch_fn(
         losses = categorical_crossentropy(probs, yb)
         return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
     def epoch_fn(params, opt_state, x, y_onehot, rng):
         n_train = x.shape[0]
         steps, padded = _epoch_plan(n_train, batch_size)
@@ -102,6 +102,11 @@ def make_epoch_fn(
         return params, opt_state, jnp.mean(losses)
 
     return epoch_fn
+
+
+def make_epoch_fn(model, tx: optax.GradientTransformation, batch_size: int) -> Callable:
+    """Jitted (donating) single-model epoch function."""
+    return partial(jax.jit, donate_argnums=(0, 1))(make_epoch_core(model, tx, batch_size))
 
 
 def init_params(model, rng, example_x) -> Any:

@@ -88,6 +88,7 @@ class ImdbTransformer(nn.Module):
     sa_layers = (5,)
     # Effective reference behavior: tuple-form entries ignored, ints kept.
     nc_layers = (3, 5)
+    all_layers = (1, 2, 3, 4, 5, 6, 7)
 
     @nn.compact
     def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[int, jnp.ndarray]]:

@@ -1,0 +1,26 @@
+"""Device-mesh parallel execution.
+
+The reference's only parallelism axis is "runs": 100 independently-trained
+models scheduled over forked worker processes by uncertainty-wizard's
+LazyEnsemble (SURVEY.md section 2.5). Here that axis becomes a *vmapped
+parameter ensemble* sharded over a ``jax.sharding.Mesh``:
+
+- all N models' parameters live in one pytree with a leading ensemble axis;
+- one jitted program trains all of them simultaneously (vmap of the epoch
+  scan), with the ensemble axis sharded across devices ("ensemble" mesh axis)
+  and, optionally, each model's batch sharded across a "data" axis;
+- XLA inserts the collectives; on a pod slice the ensemble axis rides ICI.
+
+On a single chip this still wins big: the case-study models are tiny
+(~100k params), so one chip trains dozens of them at once at high MXU
+utilization instead of 100 sequential fits.
+"""
+
+from simple_tip_tpu.parallel.ensemble import (
+    ensemble_mesh,
+    stack_init,
+    train_ensemble,
+    unstack,
+)
+
+__all__ = ["train_ensemble", "stack_init", "unstack", "ensemble_mesh"]

