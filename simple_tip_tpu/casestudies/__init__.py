@@ -1,0 +1,11 @@
+"""Case studies: MNIST, Fashion-MNIST, CIFAR-10, IMDB.
+
+Each case study binds a Flax model, a dataset loader, training hyperparameters
+and the TIP configuration (activation layers, AL selection sizes) — the
+declarative replacement for the reference's per-module constants (SURVEY.md
+section 5, config). ``get_case_study(name)`` is the registry used by the CLI.
+"""
+
+from simple_tip_tpu.casestudies.base import CaseStudy, get_case_study, CASE_STUDIES
+
+__all__ = ["CaseStudy", "get_case_study", "CASE_STUDIES"]

@@ -1,0 +1,260 @@
+"""Case-study engine: per-run training/checkpointing and the experiment phases.
+
+TPU-native counterpart of the reference's ``CaseStudy`` ABC + LazyEnsemble
+scheduler (reference: src/dnn_test_prio/case_study.py:13-144). Key
+differences by design:
+
+- Training N requested runs happens in ONE vmapped ensemble program sharded
+  over the device mesh (parallel/ensemble.py), not N forked processes.
+- Checkpoints are flax msgpack blobs under ``models/{cs}/{id}.msgpack`` with
+  the reference's reuse semantics (``delete_existing=False``: existing runs
+  are reused, not retrained).
+- No memory-leak workarounds needed (the reference's SingleUseContext,
+  memory_leak_avoider.py, exists solely for a TF/uwiz leak).
+"""
+
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+from simple_tip_tpu.config import output_folder, subdir
+from simple_tip_tpu.data import load_cifar10, load_fmnist, load_imdb, load_mnist
+from simple_tip_tpu.engine import activation_persistor, eval_active_learning, eval_prioritization
+from simple_tip_tpu.models import Cifar10ConvNet, ImdbTransformer, MnistConvNet
+from simple_tip_tpu.models.train import (
+    TrainConfig,
+    evaluate_accuracy,
+    init_params,
+    train_model,
+)
+from simple_tip_tpu.parallel import ensemble_mesh, train_ensemble, unstack
+
+logger = logging.getLogger(__name__)
+
+MAX_NUM_MODELS = 100
+
+
+@dataclass(frozen=True)
+class CaseStudySpec:
+    """Declarative configuration of one case study (hyperparameter registry)."""
+
+    name: str
+    model_factory: Callable
+    loader: Callable
+    train_cfg: TrainConfig
+    nc_activation_layers: Tuple
+    sa_activation_layers: Tuple
+    prediction_badge_size: int
+    num_classes: int
+    al_observed_share: float = 0.5
+    al_num_selected: int = 1000
+    dsa_badge_size: Optional[int] = None
+
+
+class CaseStudy:
+    """Runs training and experiment phases for one case study."""
+
+    def __init__(self, spec: CaseStudySpec):
+        self.spec = spec
+        self.model_def = spec.model_factory()
+
+    # -- checkpointing -------------------------------------------------------
+
+    def _model_dir(self) -> str:
+        return subdir(os.path.join("models", self.spec.name))
+
+    def model_path(self, model_id: int) -> str:
+        """Checkpoint path of one run's parameters."""
+        return os.path.join(self._model_dir(), f"{model_id}.msgpack")
+
+    def has_model(self, model_id: int) -> bool:
+        """Whether run ``model_id`` has a persisted checkpoint."""
+        return os.path.exists(self.model_path(model_id))
+
+    def save_params(self, model_id: int, params) -> None:
+        """Persist one run's parameters."""
+        with open(self.model_path(model_id), "wb") as f:
+            f.write(serialization.to_bytes(params))
+
+    def load_params(self, model_id: int):
+        """Load one run's parameters (template-shaped)."""
+        template = self._params_template()
+        with open(self.model_path(model_id), "rb") as f:
+            return serialization.from_bytes(template, f.read())
+
+    def _params_template(self):
+        (x_train, _), _, _ = self.spec.loader()
+        return init_params(self.model_def, jax.random.PRNGKey(0), x_train[:1])
+
+    # -- phases --------------------------------------------------------------
+
+    def train(self, model_ids: List[int], use_mesh: bool = True) -> None:
+        """Train the requested runs (reusing existing checkpoints), as one
+        vmapped ensemble across the device mesh."""
+        todo = [m for m in model_ids if not self.has_model(m)]
+        if not todo:
+            logger.info("[%s] all %d requested models exist", self.spec.name, len(model_ids))
+            return
+        (x_train, y_train), _, _ = self.spec.loader()
+        y_onehot = np.eye(self.spec.num_classes, dtype=np.float32)[
+            np.asarray(y_train).astype(np.int64).flatten()
+        ]
+        mesh = None
+        if use_mesh and len(jax.devices()) > 1:
+            mesh = ensemble_mesh(n_ensemble=len(jax.devices()), n_data=1)
+        logger.info("[%s] training runs %s", self.spec.name, todo)
+        stacked = train_ensemble(
+            self.model_def,
+            x_train,
+            y_onehot,
+            self.spec.train_cfg,
+            seeds=todo,
+            mesh=mesh,
+            verbose=True,
+        )
+        for i, model_id in enumerate(todo):
+            self.save_params(model_id, unstack(stacked, i))
+
+    def run_prio_eval(self, model_ids: List[int]) -> None:
+        """Run the test-prioritization phase for the requested runs."""
+        (x_train, _), (x_test, y_test), (ood_x, ood_y) = self.spec.loader()
+        for model_id in model_ids:
+            params = self.load_params(model_id)
+            logger.info("[%s] prioritization eval for run %d", self.spec.name, model_id)
+            eval_prioritization.evaluate(
+                model_id=model_id,
+                case_study=self.spec.name,
+                model_def=self.model_def,
+                params=params,
+                training_dataset=x_train,
+                nominal_test_dataset=x_test,
+                nominal_test_labels=y_test,
+                ood_test_dataset=ood_x,
+                ood_test_labels=ood_y,
+                nc_activation_layers=list(self.spec.nc_activation_layers),
+                sa_activation_layers=list(self.spec.sa_activation_layers),
+                dsa_badge_size=self.spec.dsa_badge_size,
+                batch_size=self.spec.prediction_badge_size,
+            )
+
+    def run_active_learning_eval(self, model_ids: List[int]) -> None:
+        """Run the active-learning phase for the requested runs."""
+        (x_train, y_train), (x_test, y_test), (ood_x, ood_y) = self.spec.loader()
+
+        def training_process(x, y_onehot, seed):
+            params = train_model(
+                self.model_def,
+                x,
+                y_onehot,
+                self.spec.train_cfg,
+                jax.random.PRNGKey(seed),
+            )
+            return self.model_def, params
+
+        def accuracy_fn(model_def, params, x, labels):
+            return evaluate_accuracy(model_def, params, x, labels)
+
+        for model_id in model_ids:
+            params = self.load_params(model_id)
+            logger.info("[%s] active-learning eval for run %d", self.spec.name, model_id)
+            eval_active_learning.evaluate(
+                model_id=model_id,
+                case_study=self.spec.name,
+                model_def=self.model_def,
+                params=params,
+                train_x=x_train,
+                train_y=y_train,
+                nominal_test_x=x_test,
+                nominal_test_labels=y_test,
+                ood_test_x=ood_x,
+                ood_test_labels=ood_y,
+                nc_activation_layers=list(self.spec.nc_activation_layers),
+                sa_activation_layers=list(self.spec.sa_activation_layers),
+                training_process=training_process,
+                observed_share=self.spec.al_observed_share,
+                num_selected=self.spec.al_num_selected,
+                num_classes=self.spec.num_classes,
+                accuracy_fn=accuracy_fn,
+                dsa_badge_size=self.spec.dsa_badge_size,
+                batch_size=self.spec.prediction_badge_size,
+            )
+
+    def collect_activations(self, model_ids: List[int]) -> None:
+        """Dump all layer activations (the at_collection phase)."""
+        (x_train, y_train), (x_test, y_test), (ood_x, ood_y) = self.spec.loader()
+        for model_id in model_ids:
+            params = self.load_params(model_id)
+            activation_persistor.persist(
+                model_def=self.model_def,
+                params=params,
+                case_study=self.spec.name,
+                model_id=model_id,
+                train_set=(x_train, y_train),
+                test_nominal=(x_test, y_test),
+                test_corrupted=(ood_x, ood_y),
+            )
+
+
+# ---------------------------------------------------------------------------
+# Registry (reference hyperparameters, SURVEY.md section 2.2 D10-D13)
+# ---------------------------------------------------------------------------
+
+CASE_STUDIES = {
+    "mnist": CaseStudySpec(
+        name="mnist",
+        model_factory=MnistConvNet,
+        loader=load_mnist,
+        train_cfg=TrainConfig(batch_size=128, epochs=15, validation_split=0.1),
+        nc_activation_layers=(0, 1, 2, 3),
+        sa_activation_layers=(3,),
+        prediction_badge_size=128,
+        num_classes=10,
+        al_num_selected=1000,
+    ),
+    "fmnist": CaseStudySpec(
+        name="fmnist",
+        model_factory=MnistConvNet,
+        loader=load_fmnist,
+        train_cfg=TrainConfig(batch_size=128, epochs=15, validation_split=0.1),
+        nc_activation_layers=(0, 1, 2, 3),
+        sa_activation_layers=(3,),
+        prediction_badge_size=128,
+        num_classes=10,
+        al_num_selected=1000,
+    ),
+    "cifar10": CaseStudySpec(
+        name="cifar10",
+        model_factory=Cifar10ConvNet,
+        loader=load_cifar10,
+        train_cfg=TrainConfig(batch_size=32, epochs=20, validation_split=0.1),
+        nc_activation_layers=(0, 1, 2, 3),
+        sa_activation_layers=(3,),
+        prediction_badge_size=32,
+        num_classes=10,
+        al_num_selected=1000,
+    ),
+    "imdb": CaseStudySpec(
+        name="imdb",
+        model_factory=ImdbTransformer,
+        loader=load_imdb,
+        train_cfg=TrainConfig(batch_size=32, epochs=10, validation_split=0.1),
+        # Tuple-form entries of the reference are silently ignored there;
+        # effective taps are (3, 5) — see models/transformer.py docstring.
+        nc_activation_layers=(3, 5),
+        sa_activation_layers=(5,),
+        prediction_badge_size=600,
+        num_classes=2,
+        al_num_selected=2500,
+        dsa_badge_size=500,
+    ),
+}
+
+
+def get_case_study(name: str) -> CaseStudy:
+    """Look up a case study by name (mnist, fmnist, cifar10, imdb)."""
+    return CaseStudy(CASE_STUDIES[name])

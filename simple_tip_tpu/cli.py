@@ -1,0 +1,105 @@
+"""Reproduction CLI: the entrypoint for running experiment phases.
+
+Equivalent of the reference's interactive typer app
+(reference: reproduction.py:184-204) with the same phases
+(training, test_prio, active_learning, evaluation, at_collection) — argparse
+based (non-interactive flags first, prompts only when flags are missing),
+which suits batch TPU jobs better than the reference's confirm-gates.
+
+Usage:
+    python -m simple_tip_tpu.cli --phase training --case-study mnist --runs 0-4
+    python -m simple_tip_tpu.cli --phase test_prio --case-study mnist --runs 0
+    python -m simple_tip_tpu.cli --phase evaluation --eval test_prio
+"""
+
+import argparse
+import logging
+import sys
+from typing import List
+
+PHASES = ["training", "test_prio", "active_learning", "evaluation", "at_collection"]
+CASE_STUDIES = ["mnist", "cifar10", "fmnist", "imdb"]
+EVALS = ["test_prio", "active_learning", "test_prio_statistics", "active_learning_statistics"]
+
+
+def _parse_runs(spec: str) -> List[int]:
+    """Parse '0', '0-4', '0,3,7' or '-1' (= all 100) into run-id lists."""
+    spec = spec.strip()
+    if spec == "-1":
+        return list(range(100))
+    runs: List[int] = []
+    for part in spec.split(","):
+        if "-" in part and not part.startswith("-"):
+            lo, hi = part.split("-")
+            runs.extend(range(int(lo), int(hi) + 1))
+        else:
+            runs.append(int(part))
+    return runs
+
+
+def _run_eval(which: str):
+    if which == "test_prio":
+        from simple_tip_tpu.plotters import eval_apfd_table
+
+        eval_apfd_table.run()
+    elif which == "active_learning":
+        from simple_tip_tpu.plotters import eval_active_learning_table
+
+        eval_active_learning_table.run()
+    elif which == "test_prio_statistics":
+        from simple_tip_tpu.plotters import eval_apfd_correlation
+
+        eval_apfd_correlation.run()
+    elif which == "active_learning_statistics":
+        from simple_tip_tpu.plotters import eval_active_correlation
+
+        eval_active_correlation.run()
+    else:
+        raise ValueError(f"Unknown eval type: {which}")
+
+
+def main(argv=None) -> int:
+    """CLI entrypoint."""
+    parser = argparse.ArgumentParser(
+        description="TPU-native reproduction of the simple-tip experiments."
+    )
+    parser.add_argument("--phase", choices=PHASES, required=True)
+    parser.add_argument("--case-study", choices=CASE_STUDIES)
+    parser.add_argument(
+        "--runs",
+        default="0",
+        help="run ids: '0', '0-4', '0,3,7', or -1 for all 100",
+    )
+    parser.add_argument("--eval", choices=EVALS, help="evaluation to run (phase=evaluation)")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO if args.verbose else logging.WARNING)
+
+    if args.phase == "evaluation":
+        which = args.eval or "test_prio"
+        _run_eval(which)
+        print("Done. Check your assets results folder for the reproduced result files.")
+        return 0
+
+    if not args.case_study:
+        parser.error("--case-study is required for non-evaluation phases")
+    runs = _parse_runs(args.runs)
+
+    from simple_tip_tpu.casestudies import get_case_study
+
+    cs = get_case_study(args.case_study)
+    if args.phase == "training":
+        cs.train(runs)
+    elif args.phase == "test_prio":
+        cs.run_prio_eval(runs)
+    elif args.phase == "active_learning":
+        cs.run_active_learning_eval(runs)
+    elif args.phase == "at_collection":
+        cs.collect_activations(runs)
+    print("Done.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

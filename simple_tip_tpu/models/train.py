@@ -19,7 +19,7 @@ it over a stacked parameter axis without modification.
 
 import math
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -128,31 +128,19 @@ def train_model(
     Replicates ``model.fit(x, y, batch_size, epochs, validation_split)``: the
     last ``validation_split`` fraction is held out (not used for anything but
     parity of the effective training set), the head is shuffled per epoch.
+    Delegates to the cached ``Trainer`` so repeated trainings share one
+    compiled epoch program.
     """
-    n = x.shape[0]
-    n_train = n - int(n * cfg.validation_split)
-    x_train = jnp.asarray(x[:n_train])
-    y_train = jnp.asarray(y_onehot[:n_train])
-
-    init_rng, epoch_rng = jax.random.split(rng)
-    params = init_params(model, init_rng, x_train[:1])
-    tx = adam_like_keras(cfg.learning_rate)
-    opt_state = tx.init(params)
-    epoch_fn = make_epoch_fn(model, tx, cfg.batch_size)
-
-    for epoch in range(cfg.epochs):
-        epoch_rng, this_rng = jax.random.split(epoch_rng)
-        params, opt_state, loss = epoch_fn(params, opt_state, x_train, y_train, this_rng)
-        if verbose:
-            print(f"epoch {epoch + 1}/{cfg.epochs} loss={float(loss):.4f}")
-    return params
+    return get_trainer(model, cfg).train(x, y_onehot, rng, verbose=verbose)
 
 
+@lru_cache(maxsize=64)
 def make_predict_fn(model, batch_size: int = 1024) -> Callable:
     """Batched deterministic forward: ``(params, x) -> probs`` (host numpy).
 
-    Pads the ragged final batch; the jitted program is traced once per input
-    shape class."""
+    Cached per (model config, batch size) — flax modules hash by config — so
+    repeated construction (e.g. ~80 retrain evaluations per active-learning
+    run) reuses one jitted program instead of recompiling."""
 
     @jax.jit
     def fwd(params, xb):
@@ -172,7 +160,8 @@ def make_predict_fn(model, batch_size: int = 1024) -> Callable:
 def make_taps_fn(
     model, activation_layers, include_last_layer: bool = False, batch_size: int = 1024
 ) -> Callable:
-    """Batched transparent forward returning the tapped layer outputs.
+    """Batched transparent forward returning the tapped layer outputs
+    (cached per configuration; see ``make_predict_fn``).
 
     Equivalent of the reference's "transparent model"
     (reference: src/dnn_test_prio/handler_model.py:175-206): selects taps whose
@@ -180,8 +169,16 @@ def make_taps_fn(
     entries are silently ignored, replicating handler_model.py:202), plus the
     final output if requested. Unconsumed taps are DCE'd by XLA.
     """
-    layer_ids = [i for i in activation_layers if isinstance(i, int)]
+    return _make_taps_fn_cached(
+        model, tuple(i for i in activation_layers if isinstance(i, int)),
+        include_last_layer, batch_size,
+    )
 
+
+@lru_cache(maxsize=64)
+def _make_taps_fn_cached(
+    model, layer_ids: Tuple[int, ...], include_last_layer: bool, batch_size: int
+) -> Callable:
     @jax.jit
     def fwd(params, xb):
         probs, taps = model.apply({"params": params}, xb, train=False)
@@ -208,6 +205,43 @@ def evaluate_accuracy(model, params, x: np.ndarray, labels: np.ndarray, batch_si
     return float(np.mean(np.argmax(probs, axis=1) == np.asarray(labels).flatten()))
 
 
+class Trainer:
+    """Reusable training harness: one jitted epoch program per (model, cfg),
+    shared across arbitrarily many from-scratch trainings (the active-learning
+    phase retrains ~80x per run with identical shapes — one compile total)."""
+
+    def __init__(self, model, cfg: TrainConfig):
+        self.model = model
+        self.cfg = cfg
+        self.tx = adam_like_keras(cfg.learning_rate)
+        self._epoch_fn = make_epoch_fn(model, self.tx, cfg.batch_size)
+
+    def train(self, x: np.ndarray, y_onehot: np.ndarray, rng, verbose: bool = False):
+        """Train a fresh model (keras-fit semantics), returning its params."""
+        cfg = self.cfg
+        n = x.shape[0]
+        n_train = n - int(n * cfg.validation_split)
+        x_train = jnp.asarray(x[:n_train])
+        y_train = jnp.asarray(y_onehot[:n_train])
+        init_rng, epoch_rng = jax.random.split(rng)
+        params = init_params(self.model, init_rng, x_train[:1])
+        opt_state = self.tx.init(params)
+        for epoch in range(cfg.epochs):
+            epoch_rng, this_rng = jax.random.split(epoch_rng)
+            params, opt_state, loss = self._epoch_fn(
+                params, opt_state, x_train, y_train, this_rng
+            )
+            if verbose:
+                print(f"epoch {epoch + 1}/{cfg.epochs} loss={float(loss):.4f}")
+        return params
+
+
+@lru_cache(maxsize=16)
+def get_trainer(model, cfg: TrainConfig) -> Trainer:
+    """Cached Trainer per (model config, train config)."""
+    return Trainer(model, cfg)
+
+
 def mc_dropout_votes(
     model, params, x: np.ndarray, n_samples: int, rng, batch_size: int = 256
 ) -> np.ndarray:
@@ -218,7 +252,19 @@ def mc_dropout_votes(
     is a ``lax.scan`` accumulating one-hot argmax votes, so peak memory is one
     batch of activations regardless of sample count.
     """
+    votes_fn = _make_votes_fn(model)
+    n = x.shape[0]
+    out = []
+    for i, start in enumerate(range(0, n, batch_size)):
+        chunk_rng = jax.random.fold_in(rng, i)
+        rngs = jax.random.split(chunk_rng, n_samples)
+        xb = jnp.asarray(x[start : start + batch_size])
+        out.append(np.asarray(votes_fn(params, xb, rngs)))
+    return np.concatenate(out, axis=0)
 
+
+@lru_cache(maxsize=16)
+def _make_votes_fn(model):
     @jax.jit
     def votes_fn(params, xb, rngs):
         def one_sample(counts, sample_rng):
@@ -233,14 +279,7 @@ def mc_dropout_votes(
         counts, _ = jax.lax.scan(one_sample, init, rngs)
         return counts
 
-    n = x.shape[0]
-    out = []
-    for i, start in enumerate(range(0, n, batch_size)):
-        chunk_rng = jax.random.fold_in(rng, i)
-        rngs = jax.random.split(chunk_rng, n_samples)
-        xb = jnp.asarray(x[start : start + batch_size])
-        out.append(np.asarray(votes_fn(params, xb, rngs)))
-    return np.concatenate(out, axis=0)
+    return votes_fn
 
 
 def _num_classes(model) -> int:

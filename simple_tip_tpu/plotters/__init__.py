@@ -1,0 +1,4 @@
+"""Result aggregation: APFD tables (Table 1), active-learning tables (Table 2),
+and Wilcoxon/Vargha-Delaney statistics (Figs 3/4), reading the filesystem
+artifact bus. CPU-only, pandas-based — mirrors the reference's src/plotters/.
+"""

@@ -1,0 +1,160 @@
+"""Wilcoxon p-value + Vargha-Delaney A12 effect-size statistics and dual
+heatmap plots (paper Figs 3/4).
+
+Reference: src/plotters/correlation_plot.py. The reference uses pingouin for
+the Wilcoxon test; here it is scipy.stats.wilcoxon (identical two-sided
+p-values). Bonferroni correction multiplies by C(num_approaches, 2).
+"""
+
+from math import comb
+from typing import Dict, List, Union
+
+import numpy as np
+from scipy import stats
+
+from simple_tip_tpu.config import subdir
+from simple_tip_tpu.plotters.utils import human_approach_names
+
+SAMPLE_KEY = Union[int, str]
+APPROACH_KEY = Union[int, str]
+
+
+def paired_vargha_delaney_a12(x: List[float], y: List[float], paired: bool = True) -> float:
+    """Scaled paired A12 effect size: 2*|A12 - 0.5|
+    (reference: correlation_plot.py:22-32)."""
+    assert len(x) == len(y)
+    x, y = np.array(x), np.array(y)
+    if not paired:
+        y = np.expand_dims(y, axis=1)
+    same = np.sum(x == y)
+    bigger = np.sum(x > y)
+    a12 = (bigger + 0.5 * same) / (x == y).size
+    return 2 * abs(a12 - 0.5)
+
+
+def wilcoxon_p(x: List[float], y: List[float]) -> float:
+    """Two-sided Wilcoxon signed-rank p-value."""
+    x, y = np.asarray(x), np.asarray(y)
+    try:
+        return float(stats.wilcoxon(x, y, alternative="two-sided").pvalue)
+    except ValueError:
+        # all-zero differences
+        return np.nan
+
+
+class WilcoxonCorrelationPlot:
+    """Pairwise Wilcoxon/A12 grid over pooled per-run measurements."""
+
+    def __init__(self, approaches: List[str], num_tested_approaches: int):
+        self.p_value_calculator = wilcoxon_p
+        self.effect_size_calculator = paired_vargha_delaney_a12
+        self.error_correction = lambda p_values: p_values * comb(num_tested_approaches, 2)
+        assert len(set(approaches)) == len(approaches), "Approach names must be unique"
+        self.approaches = approaches
+        self.measurements: Dict[APPROACH_KEY, Dict[SAMPLE_KEY, float]] = {
+            i: dict() for i in approaches
+        }
+
+    def add_measurement(self, approach, sample, value, unique: bool = True):
+        """Register an observation for statistical comparison."""
+        if approach not in self.approaches:
+            return
+        if unique:
+            assert sample not in self.measurements[approach], (
+                f"Sample key name must be unique for a given array. "
+                f"Duplicate: {sample}. Pass `unique=False` to overwrite value."
+            )
+        self.measurements[approach][sample] = value
+
+    def calc_values(self):
+        """Compute the upper-triangle p-value / effect-size / n grids."""
+        grid_size = (len(self.approaches), len(self.approaches))
+        res = {
+            "p": np.full(grid_size, 10000, dtype=np.float64),
+            "e": np.full(grid_size, -10000, dtype=np.float64),
+            "num_samples": np.full(grid_size, -1000, dtype=np.int64),
+        }
+        for i in range(len(self.approaches) - 1):
+            for j in range(i + 1, len(self.approaches)):
+                _, vals_i, vals_j = self._common(i, j)
+                res["num_samples"][i, j] = len(vals_i)
+                if len(vals_i) == 0 or vals_j == vals_i:
+                    res["p"][i, j] = np.nan
+                    res["e"][i, j] = np.nan
+                else:
+                    res["p"][i, j] = self.p_value_calculator(vals_i, vals_j)
+                    res["e"][i, j] = self.effect_size_calculator(vals_i, vals_j)
+        return res
+
+    def _common(self, i: int, j: int):
+        keys_1 = self.measurements[self.approaches[i]].keys()
+        keys_2 = set(self.measurements[self.approaches[j]].keys())
+        keys = sorted(set(keys_1).intersection(keys_2))
+        values_1 = [self.measurements[self.approaches[i]][k] for k in keys]
+        values_2 = [self.measurements[self.approaches[j]][k] for k in keys]
+        return keys, values_1, values_2
+
+    def plot_heatmap(self, exp: str, cs: str, ds: str):
+        """Render the dual-triangle heatmap (effect sizes above, p-values below)."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        import seaborn as sns
+        from matplotlib.colors import LogNorm
+
+        values = self.calc_values()
+        matrix_0 = np.triu(values["e"].transpose())
+        error_corrected_p = self.error_correction(values["p"])
+        matrix_1 = np.tril(error_corrected_p)
+
+        ax_1 = sns.heatmap(
+            values["e"].transpose(),
+            annot=False,
+            mask=matrix_0,
+            cmap="inferno",
+            square=True,
+            cbar_kws=dict(
+                shrink=0.6,
+                pad=0.05,
+                use_gridspec=True,
+                location="bottom",
+                label="Effect size",
+            ),
+        )
+        ax_2 = sns.heatmap(
+            values["p"],
+            annot=False,
+            mask=matrix_1,
+            cmap="viridis",
+            vmax=0.1,
+            square=True,
+            norm=LogNorm(),
+            cbar_kws=dict(use_gridspec=True, location="right", label="P-Value"),
+        )
+        plt.tick_params(
+            axis="both",
+            which="major",
+            labelsize=10,
+            labelbottom=False,
+            bottom=False,
+            top=True,
+            labeltop=True,
+        )
+        human_labels = human_approach_names(self.approaches)
+        ax_2.set_xticks(
+            np.arange(len(self.approaches)) + 0.5, labels=human_labels, rotation=45, ha="left"
+        )
+        ax_2.set_yticks(np.arange(len(self.approaches)) + 0.5, labels=human_labels, rotation=0)
+        ax_1.hlines([3, 6], *ax_1.get_xlim(), color="white")
+        ax_1.vlines([3, 6], *ax_1.get_ylim(), color="white")
+        plt.axline((9, 9), (0, 0), linewidth=2, color="black")
+
+        import os
+
+        if cs != "all" or ds != "both":
+            out = os.path.join(subdir("results"), f"corr-{exp}-{cs}-{ds}.png")
+        else:
+            out = os.path.join(subdir("results"), f"corr-{exp}.png")
+        plt.savefig(out, bbox_inches="tight")
+        plt.close()

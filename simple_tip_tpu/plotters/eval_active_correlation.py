@@ -1,0 +1,80 @@
+"""Active-learning statistics (paper Fig 4): pooled Wilcoxon p-values and A12
+effect sizes over the (dataset, future)-split accuracies, emitting the heatmap
+and ``results/active_correlation_{p,eff}.csv``
+(reference: src/plotters/eval_active_correlation.py).
+"""
+
+import os
+from typing import Dict, List
+
+import pandas as pd
+
+from simple_tip_tpu.config import subdir
+from simple_tip_tpu.plotters import utils
+from simple_tip_tpu.plotters.correlation_plot import WilcoxonCorrelationPlot
+from simple_tip_tpu.plotters.eval_active_learning_table import load_arrays_active_learning
+from simple_tip_tpu.plotters.utils import identify_incomplete_values, named_tuples
+
+
+def _load(case_study: str, dataset: str) -> Dict[str, Dict[int, float]]:
+    res: Dict[str, Dict[int, float]] = {approach: dict() for approach in utils.APPROACHES}
+    res["original"] = dict()
+    res["random"] = dict()
+    loaded = load_arrays_active_learning(case_study, dataset, by_id=True)
+    for i in range(100):
+        for approach in loaded:
+            if i in loaded[approach]:
+                # Significance is checked on the (dataset, future) split only.
+                split_key = (dataset, "future")
+                res[approach][i] = loaded[approach][i][split_key]
+    return res
+
+
+def _print_missing_values(cs, ds, values):
+    missing = identify_incomplete_values(values, has_dropout=cs != "cifar10")
+    if len(missing) > 0:
+        print(f"Missing values {cs} - {ds}: {missing}")
+
+
+def run(case_studies=("mnist", "fmnist", "cifar10", "imdb"), plot: bool = True):
+    """Pool AL accuracies, plot the 9-approach heatmap, emit the full CSVs."""
+    vals: List[Dict[str, Dict[str, float]]] = []
+    for cs in case_studies:
+        for ds in ["nominal", "ood"]:
+            values = _load(cs, ds)
+            _print_missing_values(cs, ds, values)
+            approaches = utils.APPROACHES.copy()
+            approaches.extend(["original", "random"])
+            vals.append(named_tuples(cs, values, None, approaches=approaches))
+
+    all_by_approach: Dict[str, Dict[str, float]] = dict()
+    for named in vals:
+        for approach, data in named.items():
+            all_by_approach.setdefault(approach, dict()).update(data)
+
+    if plot:
+        heat = WilcoxonCorrelationPlot(
+            approaches=utils.CORRELATION_PLOT_APPROACHES, num_tested_approaches=39
+        )
+        for approach, data in all_by_approach.items():
+            for measurement, value in data.items():
+                heat.add_measurement(approach, measurement, value)
+        heat.plot_heatmap("active", "all", "both")
+
+    full = WilcoxonCorrelationPlot(approaches=utils.APPROACHES, num_tested_approaches=39)
+    for approach, data in all_by_approach.items():
+        for measurement, value in data.items():
+            full.add_measurement(approach, measurement, value)
+    p_and_eff = full.calc_values()
+    human = utils.human_approach_names(utils.APPROACHES)
+    p_pd = pd.DataFrame(data=p_and_eff["p"], index=human, columns=human)
+    p_pd = p_pd.replace(10000, "")
+    p_pd.to_csv(os.path.join(subdir("results"), "active_correlation_p.csv"))
+    e_pd = pd.DataFrame(data=p_and_eff["e"], index=human, columns=human)
+    e_pd = e_pd.replace(-10000, "")
+    e_pd.to_csv(os.path.join(subdir("results"), "active_correlation_eff.csv"))
+    return p_pd, e_pd
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,169 @@
+"""Active-learning results table (paper Table 2).
+
+Loads the AL pickles by regex, averages accuracies per approach over runs,
+reports deltas vs. the ``random`` selection baseline, and emits
+``results/active.csv`` + a latex table
+(reference: src/plotters/eval_active_learning_table.py).
+"""
+
+import os
+import re
+import warnings
+from typing import Dict, List, Tuple
+
+import pandas as pd
+
+from simple_tip_tpu.config import subdir
+from simple_tip_tpu.plotters.utils import (
+    APPROACHES,
+    PAPER_APPROACHES,
+    _row,
+    human_appraoch_name,
+    load_all_for_regex,
+    vertical_categories,
+)
+
+BASELINE = "random"
+RANDOM = "random"
+
+
+def load_arrays_active_learning(
+    case_study: str, ds_name: str, by_id: bool = False
+) -> Dict[str, List[Dict[Tuple[str, str], float]]]:
+    """Per-run raw AL results for one case study and active split."""
+    res = dict()
+    incl_random = APPROACHES.copy()
+    incl_random.append(RANDOM)
+    for approach in incl_random:
+        regex = re.compile(f"{re.escape(case_study)}_\\d*_{re.escape(approach)}_{ds_name}\\.")
+        vals, files = load_all_for_regex("active_learning", regex)
+        if not by_id:
+            res[approach] = vals
+        else:
+            res[approach] = {int(files[i].split("_")[1]): vals[i] for i in range(len(vals))}
+
+    original_regex = re.compile(f"{re.escape(case_study)}_\\d*_original_na\\.")
+    original_vals, original_files = load_all_for_regex("active_learning", original_regex)
+    if not by_id:
+        res["original"] = original_vals
+    else:
+        res["original"] = {
+            int(original_files[i].split("_")[1]): original_vals[i]
+            for i in range(len(original_vals))
+        }
+    return res
+
+
+def _reduce_active_learning(cs, active_learning_files):
+    """Average each approach's per-split accuracies over runs."""
+    res = dict()
+    for approach, run_results in active_learning_files.items():
+        if len(run_results) == 0:
+            if not (approach == "VR" and cs == "cifar10"):
+                warnings.warn(f"missing AL results for {approach} on {cs}")
+            continue
+        assert all(
+            run_results[0].keys() == run_results[i].keys()
+            for i in range(1, len(run_results))
+        )
+        res[approach] = {
+            key: sum(r[key] for r in run_results) / len(run_results)
+            for key in run_results[0].keys()
+        }
+    return res
+
+
+def _relative_active_learning_gains(reduced, baseline: str):
+    """Per-approach accuracy minus the baseline selection's accuracy."""
+    assert baseline in ["random", "original"]
+    assert baseline in reduced.keys()
+    res = dict()
+    for approach, performance in reduced.items():
+        if approach == baseline:
+            continue
+        res[approach] = {
+            key: performance[key] - reduced[baseline][key] for key in performance.keys()
+        }
+    return res
+
+
+def _forma(x):
+    return "{:.2%}".format(x)
+
+
+def build_data_frame(case_studies: List[str]) -> pd.DataFrame:
+    """Assemble the full AL results dataframe."""
+    col_idx = pd.MultiIndex.from_product(
+        [
+            case_studies,
+            ["nominal", "ood"],
+            ["nominal:observed", "nominal:future", "ood:observed", "ood:future"],
+        ]
+    )
+    rows = ["original", "random"]
+    rows.extend(APPROACHES)
+    category_and_rows = [_row(row) for row in rows]
+    row_index = pd.MultiIndex.from_tuples(category_and_rows, names=["category", "approach"])
+    df = pd.DataFrame(columns=col_idx, index=row_index)
+
+    for cs in case_studies:
+        for obs in ["nominal", "ood"]:
+            file_values = load_arrays_active_learning(cs, obs)
+            reduced = _reduce_active_learning(cs, file_values)
+            if BASELINE not in reduced:
+                continue
+            relative = _relative_active_learning_gains(reduced, BASELINE)
+            for approach in ["original", "random"]:
+                if approach not in reduced:
+                    continue
+                for key in reduced[approach].keys():
+                    df.at[_row(approach), (cs, obs, f"{key[0]}:{key[1]}")] = _forma(
+                        reduced[approach][key]
+                    )
+            for approach in APPROACHES:
+                try:
+                    for key in relative[approach].keys():
+                        df.at[_row(approach), (cs, obs, f"{key[0]}:{key[1]}")] = _forma(
+                            relative[approach][key]
+                        )
+                except KeyError:
+                    for split in ["nominal:observed", "nominal:future", "ood:observed", "ood:future"]:
+                        df.at[_row(approach), (cs, obs, split)] = "n.a."
+    return df
+
+
+def latex_table(pd_df: pd.DataFrame):
+    """Emit the paper-subset latex table."""
+    paper_approaches = PAPER_APPROACHES.copy()
+    paper_approaches.extend(["original", "random"])
+    pd_df = pd_df.iloc[pd_df.index.get_level_values("approach").isin(paper_approaches)]
+    pd_df = pd_df.rename(mapper=human_appraoch_name, axis="index")
+    paper_columns = [
+        c for c in pd_df.columns if c[2].startswith(c[1]) and c[2].endswith("future")
+    ]
+    try:
+        latex = pd_df.to_latex(
+            columns=paper_columns,
+            multicolumn_format="c",
+            multirow=True,
+            column_format="llcccccccc",
+        )
+    except Exception as e:
+        warnings.warn(f"latex table rendering failed: {e}")
+        return
+    latex = vertical_categories(latex)
+    latex = latex.replace("category", "", 1)
+    with open(os.path.join(subdir("results"), "active_paper_table.tex"), "w") as f:
+        f.write(latex)
+
+
+def run(case_studies: List[str] = ("mnist", "fmnist", "cifar10", "imdb")):
+    """Generate results/active.csv and the latex table."""
+    df = build_data_frame(list(case_studies))
+    df.to_csv(os.path.join(subdir("results"), "active.csv"))
+    latex_table(df)
+    return df
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,186 @@
+"""APFD performance table (paper Table 1).
+
+Walks ``priorities/``, parses the underscore-delimited artifact names, derives
+orders (scores -> descending argsort; cam orders used directly), computes APFD
+per (approach, run), averages over runs, adds the timing columns and emits
+``results/apfds.csv`` plus a latex table
+(reference: src/plotters/eval_apfd_table.py).
+"""
+
+import os
+import warnings
+from statistics import mean
+from typing import Dict, List
+
+import numpy as np
+import pandas as pd
+
+from simple_tip_tpu.config import output_folder, subdir
+from simple_tip_tpu.ops.apfd import apfd_from_order
+from simple_tip_tpu.plotters import times_collector
+from simple_tip_tpu.plotters.utils import (
+    APPROACHES,
+    PAPER_APPROACHES,
+    _row,
+    approach_name,
+    human_appraoch_name,
+    vertical_categories,
+)
+
+TIME_COL = "time"
+
+FIRST_K_MODELS_CONSIDERED = 100
+
+
+def load_apfd_values(case_study: str, ds_name: str) -> Dict[str, Dict[int, float]]:
+    """APFD per (approach, run) for one case study and dataset."""
+    misclassifications = dict()
+    orders = dict()
+
+    for root, dirs, files in os.walk(os.path.join(output_folder(), "priorities")):
+        for file in files:
+            if not file.endswith(".npy"):
+                continue
+            if not file.startswith(f"{case_study}_{ds_name}"):
+                continue
+            arr = np.load(os.path.join(root, file))
+            if file.endswith("is_misclassified.npy"):
+                _, _, model_id, _, _ = file.split("_")
+                if int(model_id) < FIRST_K_MODELS_CONSIDERED:
+                    misclassifications[model_id] = arr
+            elif file.endswith("cam_order.npy"):
+                if "dsa" in file or "lsa" in file:
+                    _, _, model_id, metric, _, _ = file.split("_")
+                    metric = approach_name(metric, cam=True)
+                else:
+                    _, _, model_id, metric, param, _, _ = file.split("_")
+                    metric = approach_name(metric, param=param, cam=True)
+                orders[(metric, model_id)] = arr
+            else:
+                # scores
+                if "uncertainty" in file:
+                    stem = file.replace(".npy", "").replace(f"{case_study}_{ds_name}_", "")
+                    model_id, metric = stem.split("_uncertainty_")
+                elif "dsa" in file or "lsa" in file:
+                    _, _, model_id, metric, _ = file.split("_")
+                else:
+                    _, _, model_id, metric, param, _ = file.split("_")
+                    metric = approach_name(metric, param=param, cam=False)
+                orders[(metric, model_id)] = np.argsort(-arr)
+
+    apfds: Dict[str, Dict[int, float]] = dict()
+    for i in range(FIRST_K_MODELS_CONSIDERED):
+        for approach in APPROACHES:
+            try:
+                order = orders[(approach, str(i))]
+                m = misclassifications[str(i)]
+            except KeyError:
+                continue
+            apfd = apfd_from_order(m, order)
+            apfds.setdefault(approach, dict())[i] = apfd
+    return apfds
+
+
+def _get_as_df(case_studies: List[str]) -> pd.DataFrame:
+    col_idx = pd.MultiIndex.from_product([case_studies, ["nominal", "ood", TIME_COL]])
+    category_and_rows = [_row(row) for row in APPROACHES]
+    row_index = pd.MultiIndex.from_tuples(category_and_rows, names=["category", "approach"])
+    df = pd.DataFrame(columns=col_idx, index=row_index)
+
+    for case_study in case_studies:
+        for ds in ["nominal", "ood"]:
+            apfds = load_apfd_values(case_study, ds)
+            for category, approach in category_and_rows:
+                if approach in apfds and len(apfds[approach]) > 0:
+                    df.loc[(category, approach), (case_study, ds)] = np.mean(
+                        list(apfds[approach].values())
+                    )
+                else:
+                    df.loc[(category, approach), (case_study, ds)] = "n.a."
+    return df
+
+
+def _plot_latex_table(pd_df: pd.DataFrame):
+    """Emit the paper-subset latex table."""
+    pd_df = pd_df.iloc[pd_df.index.get_level_values("approach").isin(PAPER_APPROACHES)]
+    pd_df = pd_df.rename(mapper=human_appraoch_name, axis="index")
+    try:
+        latex = pd_df.to_latex(
+            multicolumn_format="c",
+            multirow=True,
+            column_format="llcccccccccccc",
+            float_format="{:.2%}".format,
+        )
+    except Exception as e:  # latex rendering is non-essential
+        warnings.warn(f"latex table rendering failed: {e}")
+        return
+    latex = vertical_categories(latex)
+    latex = latex.replace("category", "", 1)
+    with open(os.path.join(subdir("results"), "apfd_paper_table.tex"), "w") as f:
+        f.write(latex)
+
+
+def _add_reported_times(df: pd.DataFrame, partial_times: Dict):
+    """Fill the per-case-study time columns: total = setup + 2*(pred + quant)
+    (+ 2*cam for -cam rows), averaged over the first 10 runs."""
+    if not partial_times:
+        return
+    assert int(max(k[2] for k in partial_times.keys())) <= 9, "Should only consider first 10 runs"
+
+    tips = set((k[3], k[4]) for k in partial_times.keys())
+    case_studies = set(k[0] for k in partial_times.keys())
+    for cs in case_studies:
+        for tc, tn in tips:
+
+            def _match_k(k):
+                return k[0] == cs and k[3] == tc and k[4] == tn
+
+            matching = {k: v for k, v in partial_times.items() if _match_k(k)}
+            if not matching:
+                continue
+            # Pad time records to 4 entries (uncertainty metrics have no cam).
+            vals = [list(v) + [0.0] * (4 - len(v)) for v in matching.values()]
+            avg_setup = mean(v[0] for v in vals)
+            avg_pred = mean(v[1] for v in vals)
+            avg_quant = mean(v[2] for v in vals)
+            avg_cam = mean(v[3] for v in vals)
+
+            row = _times_naming_to_table_row(tc, tn)
+            if row[0] is None:
+                continue
+
+            def _format_time(t):
+                return f"{round(t)}s"
+
+            non_cam_time = avg_setup + 2 * (avg_pred + avg_quant)
+            if (cs, TIME_COL) in df.columns and row in df.index:
+                df.loc[row, (cs, TIME_COL)] = _format_time(non_cam_time)
+            if row[0] in ("surprise", "neuron coverage"):
+                cam_row = row[0], f"{row[1]}-cam"
+                if (cs, TIME_COL) in df.columns and cam_row in df.index:
+                    df.loc[cam_row, (cs, TIME_COL)] = _format_time(
+                        non_cam_time + 2 * avg_cam
+                    )
+
+
+def _times_naming_to_table_row(tip_type: str, param: str):
+    tip_type = "softmax" if tip_type == "SM" else tip_type
+    tip_type = "softmax_entropy" if tip_type == "SE" else tip_type
+    tip_type = "pcs" if tip_type == "PCS" else tip_type
+    tip_type = "deep_gini" if tip_type == "DeepGini" else tip_type
+    if param != "":
+        tip_type = f"{tip_type}_{param}"
+    return _row(tip_type)
+
+
+def run(case_studies: List[str] = ("mnist", "fmnist", "cifar10", "imdb")):
+    """Generate results/apfds.csv and the latex table."""
+    df = _get_as_df(list(case_studies))
+    _add_reported_times(df, times_collector.load_times())
+    df.to_csv(os.path.join(subdir("results"), "apfds.csv"))
+    _plot_latex_table(df)
+    return df
+
+
+if __name__ == "__main__":
+    run()

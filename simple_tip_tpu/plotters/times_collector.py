@@ -1,0 +1,35 @@
+"""Loads the per-(cs, ds, model, metric) timing pickles from the bus
+(reference: src/plotters/times_collector.py): record = [setup, pred, quant,
+cam], first 10 models only."""
+
+import os
+import pickle
+
+from simple_tip_tpu.config import output_folder
+
+N_FIRST_MODELS_CONSIDERED = 10
+
+
+def load_times():
+    """Load all timing records keyed by (cs, dataset, model, metric, param)."""
+    times = dict()
+    folder = os.path.join(output_folder(), "times")
+    for root, dirs, files in os.walk(folder):
+        for file in files:
+            file_san = (
+                file.replace("softmax_entropy", "SE")
+                .replace("pcs", "PCS")
+                .replace("deep_gini", "DeepGini")
+                .replace("softmax", "SM")
+            )
+            split = file_san.split("_")
+            if len(split) == 5:
+                case_study, dataset, model_id, metric, param = split
+            else:
+                case_study, dataset, model_id, metric = split
+                param = ""
+            if int(model_id) >= N_FIRST_MODELS_CONSIDERED:
+                continue
+            with open(os.path.join(root, file), "rb") as f:
+                times[(case_study, dataset, model_id, metric, param)] = pickle.load(f)
+    return times

@@ -1,0 +1,218 @@
+"""Shared plotter utilities: the canonical approach lists, category mapping and
+artifact-bus loaders (reference: src/plotters/utils.py)."""
+
+import logging
+import os
+import pickle
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from simple_tip_tpu.config import output_folder
+
+NUM_RUNS = 100
+
+VERTI_DEF = (
+    "\\newcommand{\\verti}[1]{\\begin{tabular}{@{}c@{}}"
+    "\\rotatebox[origin=c]{90}{\\centering #1}\\end{tabular}}"
+)
+
+# All 39 approaches tested in the experiments (load-bearing canonical order).
+APPROACHES = [
+    "NAC_0.75-cam",
+    "NAC_0.75",
+    "NAC_0-cam",
+    "NAC_0",
+    "NBC_0.5-cam",
+    "NBC_0.5",
+    "NBC_0-cam",
+    "NBC_0",
+    "NBC_1-cam",
+    "NBC_1",
+    "SNAC_0.5-cam",
+    "SNAC_0.5",
+    "SNAC_0-cam",
+    "SNAC_0",
+    "SNAC_1-cam",
+    "SNAC_1",
+    "TKNC_1-cam",
+    "TKNC_1",
+    "TKNC_2-cam",
+    "TKNC_2",
+    "TKNC_3-cam",
+    "TKNC_3",
+    "KMNC_2-cam",
+    "KMNC_2",
+    "dsa-cam",
+    "dsa",
+    "pc-lsa-cam",
+    "pc-lsa",
+    "pc-mdsa-cam",
+    "pc-mdsa",
+    "pc-mlsa-cam",
+    "pc-mlsa",
+    "pc-mmdsa-cam",
+    "pc-mmdsa",
+    "deep_gini",
+    "softmax",
+    "pcs",
+    "softmax_entropy",
+    "VR",
+]
+
+# The subset shown in the paper tables.
+PAPER_APPROACHES = [
+    "NAC_0.75-cam",
+    "NAC_0.75",
+    "NBC_0-cam",
+    "NBC_0",
+    "SNAC_0-cam",
+    "SNAC_0",
+    "TKNC_1-cam",
+    "KMNC_2",
+    "dsa",
+    "pc-lsa",
+    "pc-mdsa",
+    "pc-mlsa",
+    "pc-mmdsa",
+    "deep_gini",
+    "softmax",
+    "pcs",
+    "softmax_entropy",
+    "VR",
+]
+
+# The 9-approach subset used in the correlation plots.
+CORRELATION_PLOT_APPROACHES = [
+    "SNAC_0",
+    "SNAC_0-cam",
+    "NBC_0-cam",
+    "dsa",
+    "pc-mdsa",
+    "pc-mlsa",
+    "deep_gini",
+    "softmax",
+    "softmax_entropy",
+]
+
+
+def human_appraoch_name(approach: str) -> str:
+    """Internal approach name -> paper name. (Typo kept for reference parity.)"""
+    if approach == "softmax_entropy":
+        return "Entropy"
+    elif approach == "VR":
+        return "MC-Dropout"
+    elif approach == "softmax":
+        return "Vanilla SM"
+    elif approach == "deep_gini":
+        return "DeepGini"
+    elif approach in ["uncertainty", "surprise", "neuron coverage", "baseline"]:
+        return approach
+    else:
+        return approach.replace("_", "-").upper()
+
+
+def human_approach_names(approaches: List[str]) -> List[str]:
+    """Internal approach names -> paper names."""
+    return [human_appraoch_name(a) for a in approaches]
+
+
+def approach_name(approach: str, param: str = "", cam: bool = False) -> str:
+    """Compose an approach name with parameter and optional -cam suffix."""
+    res = approach
+    if param:
+        res += f"_{param}"
+    if cam:
+        res += "-cam"
+    return res
+
+
+def _row(approach: str) -> Tuple[str, str]:
+    return category(approach), approach
+
+
+def category(approach: str) -> Optional[str]:
+    """TIP category of an approach name."""
+    if approach in ["deep_gini", "softmax", "pcs", "softmax_entropy", "VR"]:
+        return "uncertainty"
+    if approach in [
+        "dsa-cam",
+        "dsa",
+        "pc-lsa-cam",
+        "pc-lsa",
+        "pc-mdsa-cam",
+        "pc-mdsa",
+        "pc-mlsa-cam",
+        "pc-mlsa",
+        "pc-mmdsa-cam",
+        "pc-mmdsa",
+    ]:
+        return "surprise"
+    if approach in ["original", "random"]:
+        return "baseline"
+    if any(approach.startswith(nc) for nc in ["NAC", "NBC", "SNAC", "TKNC", "KMNC"]):
+        return "neuron coverage"
+    return None
+
+
+def vertical_categories(latex: str) -> str:
+    """Rotate the category cells in a latex table."""
+    latex = VERTI_DEF + latex
+    for cat in ["uncertainty", "surprise", "baseline", "neuron coverage"]:
+        latex = latex.replace(cat, "\\verti{" + cat + "}", 1)
+    return latex
+
+
+def load_all_for_regex(research_question: str, regex: re.Pattern) -> Tuple[List, List]:
+    """Load all artifacts in a bus subfolder whose filename matches the regex."""
+    file_contents = []
+    matches = []
+    folder = os.path.join(output_folder(), research_question)
+    for root, dirs, files in os.walk(folder):
+        for file in files:
+            if regex.match(file, pos=0):
+                matches.append(file)
+                if file.endswith(".npy"):
+                    file_contents.append(np.load(os.path.join(root, file)))
+                else:
+                    with open(os.path.join(root, file), "rb") as f:
+                        file_contents.append(pickle.load(f))
+    return file_contents, matches
+
+
+def identify_incomplete_values(
+    data: Dict[str, Dict[int, float]], has_dropout: bool
+) -> Set[int]:
+    """Indices of runs with incomplete artifacts (sanity check)."""
+    missing_or_incomplete_runs = set()
+    for approach, runs in data.items():
+        for i in range(NUM_RUNS):
+            if i not in runs and (approach != "VR" or has_dropout):
+                missing_or_incomplete_runs.add(i)
+    return missing_or_incomplete_runs
+
+
+def named_tuples(
+    cs_data_id: str,
+    data: Dict[str, Dict[int, float]],
+    collection: Optional[Dict[str, Dict[str, float]]],
+    approaches: List[str],
+) -> Dict[str, Dict[str, float]]:
+    """Merge per-(cs,ds) run values into a pooled collection keyed by
+    '{cs_ds}_{run}' sample ids (for the pooled statistics)."""
+    if collection is None:
+        collection = {approach: dict() for approach in approaches}
+    else:
+        for approach in approaches:
+            assert approach in collection.keys()
+    for approach, runs in data.items():
+        if approach not in collection:
+            continue
+        for run_id, value in runs.items():
+            unique_id = f"{cs_data_id}_{run_id}"
+            if unique_id in collection[approach]:
+                logging.warning("%s: Run %s already in collection", cs_data_id, unique_id)
+            else:
+                collection[approach][unique_id] = value
+    return collection

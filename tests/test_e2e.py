@@ -1,0 +1,121 @@
+"""End-to-end smoke test (the integration test the reference never had,
+SURVEY.md section 4): a tiny synthetic case study through
+train -> test_prio -> APFD table -> active_learning -> AL table, verifying the
+filesystem artifact contract, all 39 approaches, and result CSV generation.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from simple_tip_tpu.models.train import TrainConfig, evaluate_accuracy
+
+
+@pytest.fixture()
+def tiny_assets(tmp_path, monkeypatch):
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "assets"))
+    monkeypatch.setenv("TIP_DATA_DIR", str(tmp_path / "nonexistent-data"))
+    return tmp_path
+
+
+def _tiny_case_study():
+    from simple_tip_tpu.casestudies.base import CaseStudy, CaseStudySpec
+    from simple_tip_tpu.data import synthetic
+    from simple_tip_tpu.models import MnistConvNet
+
+    def loader():
+        (x_train, y_train), (x_test, y_test) = synthetic.image_classification(
+            seed=5, n_train=240, n_test=120, shape=(16, 16, 1), num_classes=4
+        )
+        x_corr = synthetic.corrupt_images(x_test, seed=6, severity=0.6)
+        ood_x = np.concatenate([x_test, x_corr])
+        ood_y = np.concatenate([y_test, y_test])
+        perm = np.random.default_rng(0).permutation(len(ood_y))
+        return (x_train, y_train), (x_test, y_test), (ood_x[perm], ood_y[perm])
+
+    spec = CaseStudySpec(
+        name="tinymnist",
+        model_factory=lambda: MnistConvNet(num_classes=4),
+        loader=loader,
+        train_cfg=TrainConfig(batch_size=32, epochs=3, validation_split=0.1),
+        nc_activation_layers=(0, 1, 2, 3),
+        sa_activation_layers=(3,),
+        prediction_badge_size=64,
+        num_classes=4,
+        al_num_selected=10,
+    )
+    return CaseStudy(spec)
+
+
+def test_end_to_end_prio_and_al(tiny_assets):
+    from simple_tip_tpu.plotters import eval_active_learning_table, eval_apfd_table
+    from simple_tip_tpu.plotters.utils import APPROACHES
+
+    cs = _tiny_case_study()
+
+    # --- phase: training (reuses nothing, trains run 0) ---
+    cs.train([0], use_mesh=True)
+    assert cs.has_model(0)
+    params = cs.load_params(0)
+    (x_train, y_train), (x_test, y_test), _ = cs.spec.loader()
+    acc = evaluate_accuracy(cs.model_def, params, x_test, y_test)
+    assert acc > 0.4, f"tiny model failed to learn: {acc}"
+
+    # training again is a no-op (delete_existing=False semantics)
+    cs.train([0])
+
+    # --- phase: test_prio ---
+    cs.run_prio_eval([0])
+    prio = os.path.join(os.environ["TIP_ASSETS"], "priorities")
+    files = os.listdir(prio)
+    # misclassification masks for both datasets
+    assert "tinymnist_nominal_0_is_misclassified.npy" in files
+    assert "tinymnist_ood_0_is_misclassified.npy" in files
+    # all 39 approaches must be derivable: check scores/orders present
+    for unc in ["softmax", "pcs", "softmax_entropy", "deep_gini", "VR"]:
+        assert f"tinymnist_nominal_0_uncertainty_{unc}.npy" in files
+    for nc in ["NAC_0", "NAC_0.75", "NBC_0", "SNAC_1", "TKNC_3", "KMNC_2"]:
+        assert f"tinymnist_nominal_0_{nc}_scores.npy" in files
+        assert f"tinymnist_nominal_0_{nc}_cam_order.npy" in files
+    for sa in ["dsa", "pc-lsa", "pc-mdsa", "pc-mlsa", "pc-mmdsa"]:
+        assert f"tinymnist_ood_0_{sa}_scores.npy" in files
+        assert f"tinymnist_ood_0_{sa}_cam_order.npy" in files
+
+    # --- phase: evaluation (APFD table) ---
+    df = eval_apfd_table.run(case_studies=["tinymnist"])
+    assert os.path.exists(
+        os.path.join(os.environ["TIP_ASSETS"], "results", "apfds.csv")
+    )
+    for approach in APPROACHES:
+        for ds in ["nominal", "ood"]:
+            val = df.loc[
+                df.index.get_level_values("approach") == approach, ("tinymnist", ds)
+            ].iloc[0]
+            assert val != "n.a.", f"missing APFD for {approach} {ds}"
+            assert 0.0 <= float(val) <= 1.0
+
+    # --- phase: active_learning ---
+    cs.run_active_learning_eval([0])
+    al = os.path.join(os.environ["TIP_ASSETS"], "active_learning")
+    al_files = os.listdir(al)
+    assert "tinymnist_0_original_na.pickle" in al_files
+    assert "tinymnist_0_random_nominal.pickle" in al_files
+    assert "tinymnist_0_deep_gini_ood.pickle" in al_files
+    assert "tinymnist_0_NBC_0-cam_nominal.pickle" in al_files
+    assert "tinymnist_0_dsa-cam_ood.pickle" in al_files
+    # 39 approaches + random -> 40 selections x 2 splits + 1 original
+    assert len(al_files) == 40 * 2 + 1
+
+    df_al = eval_active_learning_table.run(case_studies=["tinymnist"])
+    assert os.path.exists(
+        os.path.join(os.environ["TIP_ASSETS"], "results", "active.csv")
+    )
+
+    # --- phase: at_collection ---
+    cs.collect_activations([0])
+    at_dir = os.path.join(
+        os.environ["TIP_ASSETS"], "activations", "tinymnist", "model_0", "train"
+    )
+    assert os.path.isdir(at_dir)
+    assert sorted(os.listdir(at_dir))[0] == "labels"
