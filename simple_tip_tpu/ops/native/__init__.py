@@ -1,0 +1,112 @@
+"""ctypes loader for the native host kernels (see tip_native.cpp).
+
+The library auto-builds on first import if a compiler is available; every
+caller treats this module as optional and falls back to the numpy/python path
+when the build fails (``from ... import cam_native`` raising ImportError).
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "tip_native.cpp")
+_LIB = os.path.join(_HERE, "libtipnative.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> None:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+        _build()
+    lib = ctypes.CDLL(_LIB)
+    lib.cam_greedy.restype = ctypes.c_int64
+    lib.cam_greedy.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.lev_matrix.restype = None
+    lib.lev_matrix.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.levenshtein.restype = ctypes.c_int64
+    lib.levenshtein.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+    ]
+    _lib = lib
+    return lib
+
+
+try:
+    _load()
+except Exception as e:  # pragma: no cover - depends on toolchain
+    logger.warning("native kernels unavailable (%s); using python fallbacks", e)
+    raise ImportError(f"tip native library unavailable: {e}") from e
+
+
+def cam_native(scores: np.ndarray, profiles: np.ndarray) -> np.ndarray:
+    """Full CAM order: C++ greedy picks + numpy score-ordered remainder
+    (identical semantics to the pure-python cam_order)."""
+    lib = _load()
+    prof = np.ascontiguousarray(profiles.reshape(profiles.shape[0], -1), dtype=np.uint8)
+    n, m = prof.shape
+    out = np.empty(n, dtype=np.int64)
+    n_picked = lib.cam_greedy(
+        prof.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n,
+        m,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    picked = out[:n_picked]
+    scores = np.asarray(scores, dtype=np.float64).copy()
+    min_score = scores.min() - 1
+    scores[picked] = min_score - 1
+    rest = np.argsort(-scores)
+    rest = rest[~(scores[rest] < min_score)]
+    return np.concatenate([picked, rest.astype(np.int64)])
+
+
+def lev_matrix(words: List[str]) -> np.ndarray:
+    """Pairwise Levenshtein distance matrix (uint8) over a word list."""
+    lib = _load()
+    encoded = [w.encode("utf-8") for w in words]
+    concat = b"".join(encoded)
+    offsets = np.zeros(len(words) + 1, dtype=np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    out = np.zeros((len(words), len(words)), dtype=np.uint8)
+    lib.lev_matrix(
+        concat,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(words),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out
+
+
+def levenshtein(a: str, b: str) -> int:
+    """Levenshtein distance between two strings."""
+    lib = _load()
+    ea, eb = a.encode("utf-8"), b.encode("utf-8")
+    return int(lib.levenshtein(ea, len(ea), eb, len(eb)))

@@ -1,0 +1,116 @@
+// Native kernels for host-side sequential hot loops.
+//
+// The TPU (XLA) path owns all tensor math; these C++ kernels cover the two
+// inherently-sequential host loops the interpreter would otherwise throttle:
+//
+// 1. cam_greedy: the greedy max-marginal-coverage loop of the CAM prioritizer
+//    (behavioral contract: reference src/core/prioritizers.py:16-59). Called
+//    on boolean profile matrices up to ~20k x 100k bits per (metric, dataset).
+//
+// 2. lev_matrix: the pairwise Levenshtein distance matrix of the text
+//    corruptor's dictionary (reference src/core/text_corruptor.py:282-309,
+//    which uses the polyleven C extension; this replaces it).
+//
+// Built as a plain shared library, loaded via ctypes (no pybind11 needed).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+#include <algorithm>
+
+extern "C" {
+
+// Greedy CAM picks. profiles: row-major n x m uint8 (0/1). Returns the number
+// of picked samples; picked indices (in pick order) written to out (size n).
+// Stops when the best sample adds no new coverage or everything is covered.
+int64_t cam_greedy(const uint8_t* profiles, int64_t n, int64_t m, int64_t* out) {
+    std::vector<int64_t> num_coverable(n, 0);
+    for (int64_t i = 0; i < n; ++i) {
+        const uint8_t* row = profiles + i * m;
+        int64_t s = 0;
+        for (int64_t j = 0; j < m; ++j) s += row[j];
+        num_coverable[i] = s;
+    }
+    std::vector<uint8_t> covered(m, 0);
+    std::vector<int64_t> newly;
+    newly.reserve(1024);
+    int64_t remaining = m;
+    int64_t n_picked = 0;
+    while (true) {
+        // argmax with lowest-index tie-break (matches np.argmax)
+        int64_t best = 0;
+        int64_t best_val = num_coverable[0];
+        for (int64_t i = 1; i < n; ++i) {
+            if (num_coverable[i] > best_val) {
+                best_val = num_coverable[i];
+                best = i;
+            }
+        }
+        if (best_val == 0) break;
+        out[n_picked++] = best;
+
+        const uint8_t* row = profiles + best * m;
+        newly.clear();
+        for (int64_t j = 0; j < m; ++j) {
+            if (row[j] && !covered[j]) newly.push_back(j);
+        }
+        for (int64_t i = 0; i < n; ++i) {
+            const uint8_t* r = profiles + i * m;
+            int64_t cnt = 0;
+            for (int64_t j : newly) cnt += r[j];
+            num_coverable[i] -= cnt;
+        }
+        for (int64_t j : newly) covered[j] = 1;
+        remaining -= best_val;
+        if (remaining == 0) break;
+    }
+    return n_picked;
+}
+
+static inline int lev(const char* a, int la, const char* b, int lb,
+                      std::vector<int>& dp) {
+    // single-row DP
+    if (la == 0) return lb;
+    if (lb == 0) return la;
+    dp.resize(lb + 1);
+    for (int j = 0; j <= lb; ++j) dp[j] = j;
+    for (int i = 1; i <= la; ++i) {
+        int prev = dp[0];
+        dp[0] = i;
+        for (int j = 1; j <= lb; ++j) {
+            int cur = dp[j];
+            int cost = (a[i - 1] == b[j - 1]) ? 0 : 1;
+            dp[j] = std::min(std::min(dp[j] + 1, dp[j - 1] + 1), prev + cost);
+            prev = cur;
+        }
+    }
+    return dp[lb];
+}
+
+// Full pairwise Levenshtein matrix over n words. words: concatenated bytes;
+// offsets: n+1 prefix offsets. out: n*n uint8 (distances clipped to 255).
+void lev_matrix(const char* words, const int64_t* offsets, int64_t n,
+                uint8_t* out) {
+    std::vector<int> dp;
+    for (int64_t i = 0; i < n; ++i) {
+        const char* wi = words + offsets[i];
+        int li = static_cast<int>(offsets[i + 1] - offsets[i]);
+        out[i * n + i] = 0;
+        for (int64_t j = i + 1; j < n; ++j) {
+            const char* wj = words + offsets[j];
+            int lj = static_cast<int>(offsets[j + 1] - offsets[j]);
+            int d = lev(wi, li, wj, lj, dp);
+            uint8_t v = d > 255 ? 255 : static_cast<uint8_t>(d);
+            out[i * n + j] = v;
+            out[j * n + i] = v;
+        }
+    }
+}
+
+// Single-pair Levenshtein distance.
+int64_t levenshtein(const char* a, int64_t la, const char* b, int64_t lb) {
+    std::vector<int> dp;
+    return lev(a, static_cast<int>(la), b, static_cast<int>(lb), dp);
+}
+
+}  // extern "C"

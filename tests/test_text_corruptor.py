@@ -1,0 +1,132 @@
+"""Text-corruptor tests: determinism, order/subset independence, severity
+monotonicity (the reference's documented generation contract,
+text_corruptor.py:319-335), per-type behavior, and the tokenizer/padding
+semantics of the IMDB prep."""
+
+import numpy as np
+import pytest
+
+from simple_tip_tpu.data.imdb_prep import KerasLikeTokenizer, pad_sequences
+from simple_tip_tpu.ops.text_corruptor import (
+    CorruptionType,
+    TextCorruptor,
+    bad_autocompletes,
+    split_by_whitespace,
+)
+
+BASE = [
+    "the quick brown foxes jumped over the lazy hounds while watching movies",
+    "these movies were fantastic and wonderful pieces about jumping foxes",
+    "watching fantastic movies about wonderful jumping hounds is great",
+    "quickly jumping quickly watching quickly browsing fantastic pieces",
+] * 10
+
+
+@pytest.fixture(scope="module")
+def corruptor(tmp_path_factory):
+    cache = tmp_path_factory.mktemp("corr-cache")
+    return TextCorruptor(base_dataset=BASE, cache_dir=str(cache), dictionary_size=50)
+
+
+def test_split_by_whitespace():
+    assert split_by_whitespace(["ab cd, ef"]) == [["ab", "cd", ",", "ef"]]
+
+
+def test_dictionary_contents(corruptor):
+    # words shorter than 5 chars and numbers excluded, lowercase, sorted
+    assert all(len(w) > 4 for w in corruptor.common_words)
+    assert corruptor.common_words == sorted(corruptor.common_words)
+    assert "movies" in corruptor.common_words
+
+
+def test_deterministic_and_order_independent(corruptor):
+    texts = ["watching fantastic movies about jumping hounds is wonderful today"]
+    a = corruptor.corrupt(texts, severity=0.5, seed=3, force_recalculate=True)
+    b = corruptor.corrupt(
+        ["unrelated filler text"] + texts, severity=0.5, seed=3, force_recalculate=True
+    )
+    assert a[0] == b[1]
+
+
+def test_severity_monotonic(corruptor):
+    text = ["watching fantastic movies about jumping hounds is wonderful today indeed"]
+    words_orig = text[0].split()
+    low = corruptor.corrupt(text, severity=0.3, seed=1, force_recalculate=True)[0].split()
+    high = corruptor.corrupt(text, severity=0.8, seed=1, force_recalculate=True)[0].split()
+    changed_low = {i for i, (a, b) in enumerate(zip(words_orig, low)) if a != b}
+    changed_high = {i for i, (a, b) in enumerate(zip(words_orig, high)) if a != b}
+    assert changed_low <= changed_high
+    assert len(changed_high) > len(changed_low)
+    # corrupted words at low severity are corrupted identically at high
+    for i in changed_low:
+        assert low[i] == high[i]
+
+
+def test_zero_severity_identity(corruptor):
+    texts = ["some wonderful movies about foxes"]
+    out = corruptor.corrupt(texts, severity=0.0, seed=0, force_recalculate=True)
+    assert out[0].split() == split_by_whitespace(texts)[0]
+
+
+def test_typo_changes_one_char(corruptor):
+    word = "wonderful"
+    typo = corruptor._corrupt_typo(word, seed=7)
+    assert len(typo) == len(word)
+    assert sum(a != b for a, b in zip(typo, word)) == 1
+
+
+def test_autocomplete_same_prefix(corruptor):
+    out = corruptor._corrupt_autocomplete("jumping", seed=3)
+    assert out != "jumping"
+
+
+def test_autocorrect_returns_near_word(corruptor):
+    from simple_tip_tpu.ops.native import levenshtein
+
+    out = corruptor._corrupt_autocorrect("movies", seed=3)
+    assert out != "movies"
+    assert out in corruptor.common_words
+    assert levenshtein(out, "movies") <= 6
+
+
+def test_synonym_degrades_to_typo_without_thesaurus(corruptor):
+    assert corruptor.thesaurus == {}
+    word = "fantastic"
+    out = corruptor._corrupt_synonym(word, seed=5)
+    assert len(out) == len(word)
+    assert sum(a != b for a, b in zip(out, word)) == 1
+
+
+def test_bad_autocompletes_relaxes_prefix(corruptor):
+    bag = bad_autocompletes("jumpy", corruptor.start_bags, common_letters=5)
+    assert bag is None or "jumpy" not in bag
+
+
+def test_corruption_cache_roundtrip(corruptor):
+    texts = ["fantastic wonderful movies"]
+    a = corruptor.corrupt(texts, severity=0.5, seed=9)
+    b = corruptor.corrupt(texts, severity=0.5, seed=9)  # cache hit
+    assert a == b
+
+
+# -- tokenizer ---------------------------------------------------------------
+
+
+def test_tokenizer_frequency_ranking():
+    tok = KerasLikeTokenizer(num_words=3)
+    tok.fit_on_texts(["a a a b b c", "b a"])
+    assert tok.word_index == {"a": 1, "b": 2, "c": 3}
+    # num_words=3 keeps ranks 1..2 only (keras keeps index < num_words)
+    assert tok.texts_to_sequences(["a b c d"]) == [[1, 2]]
+
+
+def test_tokenizer_filters_punctuation():
+    tok = KerasLikeTokenizer()
+    tok.fit_on_texts(["Hello, World! hello"])
+    assert tok.word_index["hello"] == 1
+    assert "," not in tok.word_index
+
+
+def test_pad_sequences_pre():
+    out = pad_sequences([[1, 2], [3, 4, 5, 6]], maxlen=3)
+    np.testing.assert_array_equal(out, [[0, 1, 2], [4, 5, 6]])
