@@ -1,0 +1,173 @@
+"""Pallas TPU kernel for DSA's masked nearest-neighbor search.
+
+DSA needs, per test activation-trace: (a) the distance to + index of the
+nearest *same-class* training AT, then (b) the distance from that neighbor to
+the nearest *other-class* training AT (reference: src/core/surprise.py:615-651,
+which materializes full (badge x train) difference tensors in RAM and
+gc-collects between badges).
+
+The XLA fallback (ops/surprise.DSA) computes a (chunk x N_train) distance
+matrix in HBM per chunk. This kernel instead tiles the training set through
+VMEM and keeps a running (min, argmin) accumulator per query row, so HBM
+traffic is one pass over the training ATs per chunk and the distance matrix
+never exists in HBM: the (chunk x tile) partial distances live in VMEM,
+produced by one MXU matmul per tile.
+
+Masking: class structure is applied by adding +inf to excluded entries before
+the row-min. Train padding rows are excluded by setting their squared-norm
+entries to +inf.
+"""
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+CHUNK = 512  # query rows per kernel launch
+TILE = 512  # training rows per grid step
+MAX_FEATURES_VMEM = 2048  # above this, fall back to the XLA path
+
+try:  # pallas import is deferred-failure: CPU-only setups keep working
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAVE_PALLAS = False
+
+
+def _nearest_kernel(
+    x_ref, xsq_ref, xlab_ref, t_ref, tsq_ref, tlab_ref, min_ref, arg_ref, *, want_same
+):
+    """One grid step: fold train tile i into the running (min, argmin)."""
+    i = pl.program_id(0)
+
+    x = x_ref[:]  # [C, D]
+    t = t_ref[:]  # [T, D]
+    # [C, T] squared distances via the MXU.
+    d2 = (
+        xsq_ref[:]  # [C, 1]
+        + tsq_ref[:]  # [1, T] (+inf on padding rows)
+        - 2.0 * jax.lax.dot_general(
+            x, t, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    )
+    d2 = jnp.maximum(d2, 0.0)
+    same = xlab_ref[:] == tlab_ref[:]  # [C,1] == [1,T] -> [C, T]
+    mask = same if want_same else jnp.logical_not(same)
+    d2m = jnp.where(mask, d2, jnp.inf)
+
+    tile_min = jnp.min(d2m, axis=1)  # [C]
+    tile_arg = jnp.argmin(d2m, axis=1).astype(jnp.int32) + i * d2m.shape[1]
+
+    @pl.when(i == 0)
+    def _():
+        min_ref[:] = tile_min
+        arg_ref[:] = tile_arg
+
+    @pl.when(i > 0)
+    def _():
+        better = tile_min < min_ref[:]
+        min_ref[:] = jnp.where(better, tile_min, min_ref[:])
+        arg_ref[:] = jnp.where(better, tile_arg, arg_ref[:])
+
+
+@functools.partial(jax.jit, static_argnames=("want_same", "interpret"))
+def _masked_nearest_call(x, x_labels, train, train_sq, train_labels, want_same, interpret=False):
+    """(min_dist2[C], argmin[C]) of x against the masked training set."""
+    c, d = x.shape
+    n = train.shape[0]
+    grid = n // TILE
+    x_sq = jnp.sum(x * x, axis=1, keepdims=True)  # [C, 1]
+    kernel = functools.partial(_nearest_kernel, want_same=want_same)
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((c, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((c,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((c,), jnp.float32),
+            jax.ShapeDtypeStruct((c,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        x,
+        x_sq,
+        x_labels.astype(jnp.int32).reshape(c, 1),
+        train,
+        train_sq.reshape(1, n),
+        train_labels.astype(jnp.int32).reshape(1, n),
+    )
+
+
+class PallasDSABackend:
+    """Device state + scoring for DSA using the pallas kernel."""
+
+    def __init__(self, train_activations: np.ndarray, train_predictions: np.ndarray):
+        n, d = train_activations.shape
+        # Pad the training set to a TILE multiple; padding rows excluded via
+        # +inf squared norms.
+        n_pad = math.ceil(n / TILE) * TILE
+        train = np.zeros((n_pad, d), np.float32)
+        train[:n] = train_activations
+        tsq = np.full(n_pad, np.inf, np.float32)
+        tsq[:n] = np.sum(train_activations.astype(np.float32) ** 2, axis=1)
+        tlab = np.full(n_pad, -2, np.int32)
+        tlab[:n] = train_predictions
+        self.n_real = n
+        self.train = jnp.asarray(train)
+        self.train_sq = jnp.asarray(tsq)
+        self.train_labels = jnp.asarray(tlab)
+
+    def score(self, target_ats: np.ndarray, target_pred: np.ndarray, interpret=False) -> np.ndarray:
+        """DSA = a_dist / b_dist per query row (chunked kernel launches)."""
+        n_test = target_ats.shape[0]
+        d = target_ats.shape[1]
+        out = np.empty(n_test, np.float64)
+        for start in range(0, n_test, CHUNK):
+            xb = target_ats[start : start + CHUNK].astype(np.float32)
+            lb = target_pred[start : start + CHUNK]
+            c_real = xb.shape[0]
+            if c_real < CHUNK:
+                xb = np.concatenate([xb, np.zeros((CHUNK - c_real, d), np.float32)])
+                lb = np.concatenate([lb, np.full(CHUNK - c_real, -1, lb.dtype)])
+            xb_j = jnp.asarray(xb)
+            lb_j = jnp.asarray(lb)
+            a2, a_idx = _masked_nearest_call(
+                xb_j, lb_j, self.train, self.train_sq, self.train_labels,
+                want_same=True, interpret=interpret,
+            )
+            closest = jnp.take(self.train, a_idx, axis=0)
+            b2, _ = _masked_nearest_call(
+                closest, lb_j, self.train, self.train_sq, self.train_labels,
+                want_same=False, interpret=interpret,
+            )
+            dsa = jnp.sqrt(a2) / jnp.sqrt(b2)
+            out[start : start + c_real] = np.asarray(dsa)[:c_real]
+        return out
+
+
+def pallas_available_for(d_features: int) -> bool:
+    """Whether the pallas DSA path applies (TPU backend, VMEM-fitting width)."""
+    if not HAVE_PALLAS:
+        return False
+    if d_features > MAX_FEATURES_VMEM:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False

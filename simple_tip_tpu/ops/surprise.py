@@ -483,6 +483,8 @@ class DSA(SA):
         self.num_classes = int(np.max(self.train_predictions)) + 1
         self.badge_size = badge_size
         self._device_state = None
+        self._pallas_backend = None
+        self.use_pallas: Optional[bool] = None  # None = auto-detect
 
     def _prepare_device(self):
         import jax
@@ -522,6 +524,23 @@ class DSA(SA):
 
         target_pred = _class_predictions(predictions)
         target_ats = _flatten_layers(activations).astype(np.float32)
+
+        # Prefer the pallas kernel on TPU (no HBM-resident distance matrix);
+        # fall back to the chunked XLA formulation elsewhere.
+        use_pallas = self.use_pallas
+        if use_pallas is None:
+            from simple_tip_tpu.ops.dsa_pallas import pallas_available_for
+
+            use_pallas = pallas_available_for(target_ats.shape[1])
+        if use_pallas:
+            if self._pallas_backend is None:
+                from simple_tip_tpu.ops.dsa_pallas import PallasDSABackend
+
+                self._pallas_backend = PallasDSABackend(
+                    self.train_activations, self.train_predictions
+                )
+            return self._pallas_backend.score(target_ats, target_pred)
+
         if self._device_state is None:
             self._prepare_device()
         _, _, _, dsa_chunk = self._device_state

@@ -1,0 +1,32 @@
+"""Pallas DSA kernel equivalence test (interpret mode on CPU): the masked
+nearest-neighbor kernel must agree with the XLA fallback formulation."""
+
+import numpy as np
+import pytest
+
+from simple_tip_tpu.ops import dsa_pallas
+from simple_tip_tpu.ops.surprise import DSA
+
+
+@pytest.mark.skipif(not dsa_pallas.HAVE_PALLAS, reason="pallas unavailable")
+def test_pallas_interpret_matches_xla(monkeypatch):
+    # Shrink tiles so tiny shapes still exercise multi-tile accumulation.
+    monkeypatch.setattr(dsa_pallas, "CHUNK", 128)
+    monkeypatch.setattr(dsa_pallas, "TILE", 128)
+
+    rng = np.random.RandomState(0)
+    acts = rng.random((384, 32)).astype(np.float32)
+    labels = rng.randint(0, 4, size=384)
+    test = rng.random((200, 32)).astype(np.float32)
+    tlabels = rng.randint(0, 4, size=200)
+
+    d_ref = DSA(acts, labels)
+    d_ref.use_pallas = False
+    expected = d_ref(test, tlabels)
+
+    backend = dsa_pallas.PallasDSABackend(
+        d_ref.train_activations, d_ref.train_predictions
+    )
+    got = backend.score(test.astype(np.float32), tlabels, interpret=True)
+
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
