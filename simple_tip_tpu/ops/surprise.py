@@ -41,6 +41,25 @@ logger = logging.getLogger(__name__)
 # ---------------------------------------------------------------------------
 
 
+def _cluster_backend():
+    """(KMeans, silhouette_score, GaussianMixture) from the configured backend.
+
+    Default: the TPU-native jnp implementations (ops/cluster.py). Set
+    ``TIP_CLUSTER_BACKEND=sklearn`` to cross-validate against sklearn's.
+    """
+    import os
+
+    if os.environ.get("TIP_CLUSTER_BACKEND", "jax") == "sklearn":
+        from sklearn.cluster import KMeans
+        from sklearn.metrics import silhouette_score
+        from sklearn.mixture import GaussianMixture
+
+        return KMeans, silhouette_score, GaussianMixture
+    from simple_tip_tpu.ops.cluster import GaussianMixture, KMeans, silhouette_score
+
+    return KMeans, silhouette_score, GaussianMixture
+
+
 def _subsample_array(subsampling, array: np.ndarray, seed: int) -> np.ndarray:
     """Subsample a single array (int = count, float in (0,1) = share)."""
     return _subsample_arrays(subsampling, (array,), seed=seed)[0]
@@ -131,8 +150,7 @@ class _KmeansDiscriminator:
         max_iter: int = 300,
         seed: Optional[int] = 0,
     ):
-        from sklearn.cluster import KMeans
-        from sklearn.metrics import silhouette_score
+        KMeans, silhouette_score, _ = _cluster_backend()
 
         training_data = _flatten_layers(training_data)
         training_data = _subsample_array(
@@ -433,7 +451,7 @@ class MLSA(SA):
         num_components: int = 2,
         seed: Optional[int] = 0,
     ):
-        from sklearn.mixture import GaussianMixture
+        _, _, GaussianMixture = _cluster_backend()
 
         activations = _flatten_layers(activations)
         logger.info("Fitting Gaussian Mixture with %d components", num_components)
