@@ -1,0 +1,63 @@
+"""Multi-host distribution helpers.
+
+The reference's inter-process transport is fork+pickle plus the ``/assets``
+filesystem (SURVEY.md section 2.5); scale-out here is JAX-native:
+``initialize()`` wires up ``jax.distributed`` (ICI within a slice, DCN across
+hosts), ``global_ensemble_mesh`` builds a mesh over all global devices, and
+``host_local_model_ids`` splits the 100-run id range so each host trains and
+persists its own shard of the ensemble (keeping artifact writes
+host-local — the filesystem bus stays the coordination-free checkpoint
+mechanism it is in the reference).
+"""
+
+import logging
+from typing import List, Optional, Sequence
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize jax.distributed (no-op when single-process or already up)."""
+    if jax.process_count() > 1:
+        return
+    if coordinator_address is None:
+        logger.info("single-process run; jax.distributed not initialized")
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "jax.distributed up: process %d/%d, %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+    )
+
+
+def global_ensemble_mesh(n_data: int = 1):
+    """(ensemble, data) mesh over all global devices (multi-host aware)."""
+    from simple_tip_tpu.parallel.ensemble import ensemble_mesh
+
+    return ensemble_mesh(n_data=n_data, devices=jax.devices())
+
+
+def host_local_model_ids(model_ids: Sequence[int]) -> List[int]:
+    """The subset of run ids this host is responsible for (contiguous split,
+    remainder to the leading hosts)."""
+    ids = list(model_ids)
+    n_proc = jax.process_count()
+    if n_proc == 1:
+        return ids
+    rank = jax.process_index()
+    base, rem = divmod(len(ids), n_proc)
+    start = rank * base + min(rank, rem)
+    size = base + (1 if rank < rem else 0)
+    return ids[start : start + size]

@@ -1,0 +1,100 @@
+"""Ring attention: sequence/context-parallel attention over a device mesh.
+
+The reference has no long-context machinery (max sequence length is 100,
+SURVEY.md section 5), but this framework treats sequence parallelism as a
+first-class capability: ``ring_attention`` computes exact (non-approximate)
+attention with the sequence axis sharded across devices. Each device holds its
+local Q/K/V block; K/V blocks rotate around the ring via ``jax.lax.ppermute``
+while a numerically-stable streaming softmax (flash-attention style
+max/normalizer/output accumulators) folds in one block per step. Communication
+is neighbor-to-neighbor only, so it rides ICI on a TPU pod slice.
+
+``ring_attention_sharded`` wraps the collective in ``shard_map`` over a mesh
+axis; ``ring_self_attention_reference`` is the dense single-device oracle used
+by the tests.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_update(carry, k_blk, v_blk, q, scale):
+    """Fold one K/V block into the streaming-softmax accumulators."""
+    o, m, l = carry  # [B,H,Tq,Dh], [B,H,Tq], [B,H,Tq]
+    # scores: [B, H, Tq, Tkv]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+    o_new = o * correction[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, axis_name: str, n_dev: int):
+    """Exact attention with K/V ring-rotated across ``axis_name``.
+
+    Shapes (per device): q/k/v = [batch, seq_local, heads, head_dim].
+    ``n_dev`` is the static size of the mesh axis.
+    Returns [batch, seq_local, heads, head_dim].
+    """
+    scale = np.float32(1.0 / np.sqrt(q.shape[-1]))
+    b, t_q, h, dh = q.shape
+
+    # pvary: mark the fresh accumulators as device-varying over the ring axis
+    # so the scan carry types line up (shard_map vma semantics).
+    o = jax.lax.pvary(jnp.zeros((b, h, t_q, dh), q.dtype), axis_name)
+    m = jax.lax.pvary(jnp.full((b, h, t_q), -jnp.inf, q.dtype), axis_name)
+    l = jax.lax.pvary(jnp.zeros((b, h, t_q), q.dtype), axis_name)
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+
+    def step(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        o, m, l = _block_update((o, m, l), k_blk, v_blk, q, scale)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, m, l, k_blk, v_blk
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, n_dev, step, (o, m, l, k, v))
+    out = o / l[..., None]
+    return jnp.einsum("bhqd->bqhd", out)
+
+
+def ring_self_attention_reference(q, k, v):
+    """Dense single-device attention oracle (same layout as ring_attention)."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bhqd", weights, v)
+    return jnp.einsum("bhqd->bqhd", out)
+
+
+def ring_attention_sharded(
+    q: np.ndarray, k: np.ndarray, v: np.ndarray, mesh: Mesh, axis: str = "sp"
+):
+    """Run ring attention with the sequence axis of q/k/v sharded over
+    ``axis`` of ``mesh``. Host-convenience wrapper around shard_map."""
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis, n_dev=mesh.shape[axis]),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    sharding = NamedSharding(mesh, spec)
+    q = jax.device_put(jnp.asarray(q), sharding)
+    k = jax.device_put(jnp.asarray(k), sharding)
+    v = jax.device_put(jnp.asarray(v), sharding)
+    return jax.jit(fn)(q, k, v)
+
+
+def sequence_parallel_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the sequence-parallel axis."""
+    devices = jax.devices() if n_devices is None else jax.devices()[:n_devices]
+    return Mesh(np.asarray(devices), ("sp",))
