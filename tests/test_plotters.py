@@ -1,0 +1,136 @@
+"""Plotter-layer tests (the reference leaves this layer untested): artifact
+name parsing, APFD aggregation, time accounting, AL reduction, and the
+Wilcoxon/A12 statistics against closed-form cases."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from simple_tip_tpu.plotters.correlation_plot import (
+    WilcoxonCorrelationPlot,
+    paired_vargha_delaney_a12,
+    wilcoxon_p,
+)
+from simple_tip_tpu.plotters.utils import (
+    APPROACHES,
+    approach_name,
+    category,
+    human_appraoch_name,
+)
+
+
+def test_approaches_canonical():
+    assert len(APPROACHES) == 39
+    assert len(set(APPROACHES)) == 39
+    for a in APPROACHES:
+        assert category(a) is not None
+
+
+def test_approach_name_composition():
+    assert approach_name("NBC", param="0.5", cam=True) == "NBC_0.5-cam"
+    assert approach_name("dsa", cam=True) == "dsa-cam"
+    assert approach_name("deep_gini") == "deep_gini"
+
+
+def test_human_names():
+    assert human_appraoch_name("softmax_entropy") == "Entropy"
+    assert human_appraoch_name("VR") == "MC-Dropout"
+    assert human_appraoch_name("pc-mdsa") == "PC-MDSA"
+
+
+def test_a12_effect_size():
+    # identical -> 0; fully dominant -> 1
+    assert paired_vargha_delaney_a12([1, 2, 3], [1, 2, 3]) == 0.0
+    assert paired_vargha_delaney_a12([2, 3, 4], [1, 2, 3]) == 1.0
+    assert paired_vargha_delaney_a12([1, 2, 3], [2, 3, 4]) == 1.0  # symmetric scaled
+
+
+def test_wilcoxon_p_matches_scipy_and_handles_ties():
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=30)
+    y = x + rng.normal(0.5, 0.1, size=30)
+    p = wilcoxon_p(list(x), list(y))
+    assert 0 <= p < 0.01
+    # all-tied inputs: scipy reports p=1 (and calc_values NaN-guards this
+    # case before ever calling)
+    p_tied = wilcoxon_p([1.0, 2.0], [1.0, 2.0])
+    assert np.isnan(p_tied) or p_tied == 1.0
+
+
+def test_correlation_grid():
+    plot = WilcoxonCorrelationPlot(approaches=["a", "b", "c"], num_tested_approaches=39)
+    rng = np.random.RandomState(1)
+    for i in range(40):
+        base = rng.normal()
+        plot.add_measurement("a", f"s{i}", base + 1.0 + rng.normal(0, 0.01))
+        plot.add_measurement("b", f"s{i}", base + rng.normal(0, 0.01))
+        plot.add_measurement("c", f"s{i}", base + rng.normal(0, 0.01))
+    vals = plot.calc_values()
+    # a dominates b: tiny p, effect size 1
+    assert vals["p"][0, 1] < 1e-5
+    assert vals["e"][0, 1] == 1.0
+    assert vals["num_samples"][0, 1] == 40
+    # duplicate sample keys rejected
+    with pytest.raises(AssertionError):
+        plot.add_measurement("a", "s0", 1.0)
+
+
+def test_times_collector_and_table_naming(tmp_path, monkeypatch):
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path))
+    times_dir = tmp_path / "times"
+    times_dir.mkdir()
+    rec = [1.0, 2.0, 3.0, 4.0]
+    for name in [
+        "mnist_nominal_0_softmax",
+        "mnist_nominal_0_NBC_0.5",
+        "mnist_nominal_11_softmax",  # beyond first-10, must be skipped
+    ]:
+        with open(times_dir / name, "wb") as f:
+            pickle.dump(rec, f)
+
+    from simple_tip_tpu.plotters.times_collector import load_times
+
+    times = load_times()
+    assert ("mnist", "nominal", "0", "SM", "") in times
+    assert ("mnist", "nominal", "0", "NBC", "0.5") in times
+    assert not any(k[2] == "11" for k in times)
+
+
+def test_apfd_table_from_synthetic_artifacts(tmp_path, monkeypatch):
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path))
+    prio = tmp_path / "priorities"
+    prio.mkdir()
+    rng = np.random.RandomState(0)
+    n = 50
+    mis = rng.rand(n) < 0.3
+    for ds in ["nominal", "ood"]:
+        np.save(prio / f"demo_{ds}_0_is_misclassified.npy", mis)
+        np.save(prio / f"demo_{ds}_0_uncertainty_deep_gini.npy", rng.rand(n))
+        np.save(prio / f"demo_{ds}_0_NBC_0_scores.npy", rng.rand(n))
+        np.save(
+            prio / f"demo_{ds}_0_NBC_0_cam_order.npy", rng.permutation(n)
+        )
+        np.save(prio / f"demo_{ds}_0_dsa_scores.npy", rng.rand(n))
+        np.save(prio / f"demo_{ds}_0_dsa_cam_order.npy", rng.permutation(n))
+
+    from simple_tip_tpu.plotters.eval_apfd_table import load_apfd_values, run
+
+    apfds = load_apfd_values("demo", "nominal")
+    assert set(apfds.keys()) == {"deep_gini", "NBC_0", "NBC_0-cam", "dsa", "dsa-cam"}
+    for vals in apfds.values():
+        assert 0 <= vals[0] <= 1
+
+    df = run(case_studies=["demo"])
+    assert (tmp_path / "results" / "apfds.csv").exists()
+    assert df.loc[("uncertainty", "deep_gini"), ("demo", "nominal")] == apfds["deep_gini"][0]
+
+
+def test_cli_runs_parser():
+    from simple_tip_tpu.cli import _parse_runs
+
+    assert _parse_runs("0") == [0]
+    assert _parse_runs("0-3") == [0, 1, 2, 3]
+    assert _parse_runs("0,5,9") == [0, 5, 9]
+    assert _parse_runs("-1") == list(range(100))
