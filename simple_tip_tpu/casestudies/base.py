@@ -93,9 +93,12 @@ class CaseStudy:
 
     # -- phases --------------------------------------------------------------
 
-    def train(self, model_ids: List[int], use_mesh: bool = True) -> None:
-        """Train the requested runs (reusing existing checkpoints), as one
-        vmapped ensemble across the device mesh."""
+    def train(
+        self, model_ids: List[int], use_mesh: bool = True, group_size: int = 16
+    ) -> None:
+        """Train the requested runs (reusing existing checkpoints) as vmapped
+        ensembles across the device mesh, in memory-bounded groups of
+        ``group_size`` members per device."""
         todo = [m for m in model_ids if not self.has_model(m)]
         if not todo:
             logger.info("[%s] all %d requested models exist", self.spec.name, len(model_ids))
@@ -105,20 +108,24 @@ class CaseStudy:
             np.asarray(y_train).astype(np.int64).flatten()
         ]
         mesh = None
-        if use_mesh and len(jax.devices()) > 1:
-            mesh = ensemble_mesh(n_ensemble=len(jax.devices()), n_data=1)
+        n_dev = len(jax.devices())
+        if use_mesh and n_dev > 1:
+            mesh = ensemble_mesh(n_ensemble=n_dev, n_data=1)
+        chunk = group_size * max(1, n_dev if mesh is not None else 1)
         logger.info("[%s] training runs %s", self.spec.name, todo)
-        stacked = train_ensemble(
-            self.model_def,
-            x_train,
-            y_onehot,
-            self.spec.train_cfg,
-            seeds=todo,
-            mesh=mesh,
-            verbose=True,
-        )
-        for i, model_id in enumerate(todo):
-            self.save_params(model_id, unstack(stacked, i))
+        for start in range(0, len(todo), chunk):
+            group = todo[start : start + chunk]
+            stacked = train_ensemble(
+                self.model_def,
+                x_train,
+                y_onehot,
+                self.spec.train_cfg,
+                seeds=group,
+                mesh=mesh,
+                verbose=True,
+            )
+            for i, model_id in enumerate(group):
+                self.save_params(model_id, unstack(stacked, i))
 
     def run_prio_eval(self, model_ids: List[int]) -> None:
         """Run the test-prioritization phase for the requested runs."""
@@ -142,8 +149,13 @@ class CaseStudy:
                 batch_size=self.spec.prediction_badge_size,
             )
 
-    def run_active_learning_eval(self, model_ids: List[int]) -> None:
-        """Run the active-learning phase for the requested runs."""
+    def run_active_learning_eval(
+        self, model_ids: List[int], ensemble_retrain: bool = True, group_size: int = 16
+    ) -> None:
+        """Run the active-learning phase for the requested runs.
+
+        ``ensemble_retrain`` (default) trains the ~80 per-TIP retrainings of
+        each run as grouped vmapped ensembles instead of sequentially."""
         (x_train, y_train), (x_test, y_test), (ood_x, ood_y) = self.spec.loader()
 
         def training_process(x, y_onehot, seed):
@@ -158,6 +170,28 @@ class CaseStudy:
 
         def accuracy_fn(model_def, params, x, labels):
             return evaluate_accuracy(model_def, params, x, labels)
+
+        batch_training_process = None
+        if ensemble_retrain:
+            from simple_tip_tpu.parallel.al_ensemble import al_retrain_ensemble
+
+            eye = np.eye(self.spec.num_classes, dtype=np.float32)
+            train_y_onehot = eye[np.asarray(y_train).astype(np.int64).flatten()]
+
+            def batch_training_process(sels):
+                prepared = [
+                    (x, eye[np.asarray(y).astype(np.int64).flatten()], seed)
+                    for (x, y, seed) in sels
+                ]
+                params_list = al_retrain_ensemble(
+                    self.model_def,
+                    self.spec.train_cfg,
+                    x_train,
+                    train_y_onehot,
+                    prepared,
+                    group_size=group_size,
+                )
+                return [(self.model_def, p) for p in params_list]
 
         for model_id in model_ids:
             params = self.load_params(model_id)
@@ -182,6 +216,7 @@ class CaseStudy:
                 accuracy_fn=accuracy_fn,
                 dsa_badge_size=self.spec.dsa_badge_size,
                 batch_size=self.spec.prediction_badge_size,
+                batch_training_process=batch_training_process,
             )
 
     def collect_activations(self, model_ids: List[int]) -> None:

@@ -43,6 +43,12 @@ FUT = "future"
 TrainingProcess = Callable[[np.ndarray, np.ndarray, int], Tuple[object, object]]
 """(x, y_onehot, seed) -> (model_def, params): retrains a model from scratch."""
 
+BatchTrainingProcess = Callable[
+    [List[Tuple[np.ndarray, np.ndarray, int]]], List[Tuple[object, object]]
+]
+"""[(x_sel, y_sel, seed)] -> [(model_def, params)]: retrains one model per
+selection, typically as a vmapped ensemble (parallel/al_ensemble.py)."""
+
 Evaluator = Callable[[object, object, np.ndarray, np.ndarray], float]
 
 
@@ -66,6 +72,7 @@ def evaluate(
     accuracy_fn: Evaluator,
     dsa_badge_size: Optional[int] = None,
     batch_size: int = 128,
+    batch_training_process: Optional[BatchTrainingProcess] = None,
 ) -> None:
     """Evaluate the active-learning capabilities of every TIP for one run."""
     active_datasets = _shuffle_and_split_datasets(
@@ -110,16 +117,32 @@ def evaluate(
     _selection_sanity_checks(num_selected, selections)
 
     active_accuracies = {}
-    for i, ((metric, ood_or_nom), selected_indexes) in enumerate(selections.items()):
-        x = active_datasets[ood_or_nom, OBS][0][selected_indexes]
-        y = active_datasets[ood_or_nom, OBS][1][selected_indexes]
-        new_model_def, new_params = _retrain(
-            num_classes, training_process, train_x, train_y, x, y, seed=model_id * 1000 + i
-        )
-        # Evaluate on all four splits (cheap now, interesting later).
-        active_accuracies[(metric, ood_or_nom)] = _evaluate(
-            new_model_def, new_params, active_datasets, accuracy_fn
-        )
+    if batch_training_process is not None:
+        # Ensemble path: all retrainings train simultaneously on device.
+        sels = []
+        for i, ((metric, ood_or_nom), selected_indexes) in enumerate(selections.items()):
+            x = active_datasets[ood_or_nom, OBS][0][selected_indexes]
+            y = active_datasets[ood_or_nom, OBS][1][selected_indexes]
+            sels.append((x, np.asarray(y).flatten(), model_id * 1000 + i))
+        retrained = batch_training_process(sels)
+        for ((metric, ood_or_nom), _), (new_model_def, new_params) in zip(
+            selections.items(), retrained
+        ):
+            active_accuracies[(metric, ood_or_nom)] = _evaluate(
+                new_model_def, new_params, active_datasets, accuracy_fn
+            )
+    else:
+        for i, ((metric, ood_or_nom), selected_indexes) in enumerate(selections.items()):
+            x = active_datasets[ood_or_nom, OBS][0][selected_indexes]
+            y = active_datasets[ood_or_nom, OBS][1][selected_indexes]
+            new_model_def, new_params = _retrain(
+                num_classes, training_process, train_x, train_y, x, y,
+                seed=model_id * 1000 + i,
+            )
+            # Evaluate on all four splits (cheap now, interesting later).
+            active_accuracies[(metric, ood_or_nom)] = _evaluate(
+                new_model_def, new_params, active_datasets, accuracy_fn
+            )
 
     _save_results_on_file(case_study, model_id, "original", "na", original_model_eval)
     for (metric, ood_or_nom), eval_res in active_accuracies.items():

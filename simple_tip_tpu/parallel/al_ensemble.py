@@ -1,0 +1,175 @@
+"""Batched active-learning retraining: all ~80 per-TIP retrainings of one AL
+run train simultaneously as a vmapped parameter ensemble.
+
+The reference retrains sequentially, one full ``model.fit`` per selection
+(reference: src/dnn_test_prio/eval_active_learning.py:100-115) — its
+wall-clock monster. Here every retraining shares the same base training set
+and differs only in its ``num_selected`` extra samples, so device memory holds
+ONE copy of the base set plus a stacked ``[S, k, ...]`` extras tensor; the
+vmapped epoch gathers each member's batch from base-or-extras by index.
+
+Keras parity detail: the reference shuffles base+selection and then lets
+``fit`` hold out the LAST 10% as validation — so selected samples can land in
+the held-out part. We reproduce that exactly with a per-member host
+permutation (``member_perm``) mapping logical slots to physical rows; the
+training loop only touches the first 90% of logical slots.
+
+Memory scales with the member-group size (activations are materialized per
+member under vmap), so retrainings run in groups of ``group_size``.
+"""
+
+import math
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from simple_tip_tpu.models.train import (
+    TrainConfig,
+    adam_like_keras,
+    categorical_crossentropy,
+    init_params,
+)
+
+
+def make_al_epoch_core(model, tx, batch_size: int):
+    """Un-jitted epoch over (shared base set + per-member extras).
+
+    Args per call: params, opt_state, shared_x [n,...], shared_y [n,C],
+    extra_x [k,...], extra_y [k,C], member_perm [n_train] (logical->physical
+    over n+k rows), rng. vmapped over (params, opt_state, extra_x, extra_y,
+    member_perm, rng).
+    """
+
+    def loss_fn(params, xb, yb, mask, dropout_rng):
+        probs, _ = model.apply(
+            {"params": params}, xb, train=True, rngs={"dropout": dropout_rng}
+        )
+        losses = categorical_crossentropy(probs, yb)
+        return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    def epoch(params, opt_state, shared_x, shared_y, extra_x, extra_y, member_perm, rng):
+        n_shared = shared_x.shape[0]
+        n_train = member_perm.shape[0]
+        steps = math.ceil(n_train / batch_size)
+        padded = steps * batch_size
+        perm_rng, dropout_rng = jax.random.split(rng)
+        perm = jax.random.permutation(perm_rng, n_train)
+        physical = jnp.take(member_perm, perm)
+        physical = jnp.concatenate(
+            [physical, jnp.zeros(padded - n_train, physical.dtype)]
+        )
+        mask = (jnp.arange(padded) < n_train).astype(jnp.float32)
+        physical = physical.reshape(steps, batch_size)
+        mask = mask.reshape(steps, batch_size)
+        step_rngs = jax.random.split(dropout_rng, steps)
+
+        def gather(idx):
+            in_shared = idx < n_shared
+            xb_s = jnp.take(shared_x, jnp.clip(idx, 0, n_shared - 1), axis=0)
+            yb_s = jnp.take(shared_y, jnp.clip(idx, 0, n_shared - 1), axis=0)
+            e_idx = jnp.clip(idx - n_shared, 0, extra_x.shape[0] - 1)
+            xb_e = jnp.take(extra_x, e_idx, axis=0)
+            yb_e = jnp.take(extra_y, e_idx, axis=0)
+            sel = in_shared.reshape((-1,) + (1,) * (xb_s.ndim - 1))
+            return (
+                jnp.where(sel, xb_s, xb_e),
+                jnp.where(in_shared[:, None], yb_s, yb_e),
+            )
+
+        def step(carry, sl):
+            params, opt_state = carry
+            idx, batch_mask, step_rng = sl
+            xb, yb = gather(idx)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, xb, yb, batch_mask, step_rng
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax_apply(params, updates)
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), (physical, mask, step_rngs)
+        )
+        return params, opt_state, jnp.mean(losses)
+
+    return epoch
+
+
+def optax_apply(params, updates):
+    import optax
+
+    return optax.apply_updates(params, updates)
+
+
+def al_retrain_ensemble(
+    model,
+    cfg: TrainConfig,
+    train_x: np.ndarray,
+    train_y_onehot: np.ndarray,
+    selections: List[Tuple[np.ndarray, np.ndarray, int]],
+    group_size: int = 16,
+    verbose: bool = False,
+) -> List:
+    """Train one fresh model per (x_sel, y_sel_onehot, seed) selection; all
+    selections must have equal k. Returns host-side params per selection."""
+    n = train_x.shape[0]
+    k = selections[0][0].shape[0]
+    assert all(s[0].shape[0] == k for s in selections), "equal selection sizes required"
+    total = n + k
+    n_train = total - int(total * cfg.validation_split)
+
+    tx = adam_like_keras(cfg.learning_rate)
+    epoch_core = make_al_epoch_core(model, tx, cfg.batch_size)
+    epoch_vmapped = partial(jax.jit, donate_argnums=(0, 1))(
+        jax.vmap(epoch_core, in_axes=(0, 0, None, None, 0, 0, 0, 0))
+    )
+
+    shared_x = jnp.asarray(train_x)
+    shared_y = jnp.asarray(train_y_onehot)
+
+    results: List = []
+    for g_start in range(0, len(selections), group_size):
+        group = list(selections[g_start : g_start + group_size])
+        n_real = len(group)
+        # Pad the ragged last group so every group compiles to the same shape.
+        while len(group) < group_size and len(selections) > group_size:
+            group.append(group[0])
+        extra_x = jnp.asarray(np.stack([s[0] for s in group]))
+        extra_y = jnp.asarray(np.stack([s[1] for s in group]))
+        seeds = [s[2] for s in group]
+        # Per-member shuffle-then-split permutation (keras fit parity).
+        perms = np.stack(
+            [np.random.RandomState(seed).permutation(total)[:n_train] for seed in seeds]
+        ).astype(np.int32)
+        member_perm = jnp.asarray(perms)
+
+        def one_init(seed):
+            return init_params(model, jax.random.PRNGKey(seed), shared_x[:1])
+
+        params = jax.vmap(one_init)(jnp.asarray(seeds, dtype=jnp.uint32))
+        opt_state = jax.vmap(tx.init)(params)
+        rngs = jnp.stack([jax.random.PRNGKey(int(s) + 20_000) for s in seeds])
+
+        for epoch in range(cfg.epochs):
+            this_rngs = jax.vmap(lambda r: jax.random.fold_in(r, epoch))(rngs)
+            params, opt_state, losses = epoch_vmapped(
+                params,
+                opt_state,
+                shared_x,
+                shared_y,
+                extra_x,
+                extra_y,
+                member_perm,
+                this_rngs,
+            )
+            if verbose:
+                print(
+                    f"AL group {g_start // group_size}: epoch {epoch + 1}/"
+                    f"{cfg.epochs} loss={np.asarray(losses).mean():.4f}"
+                )
+        for i in range(n_real):
+            results.append(jax.tree.map(lambda leaf: np.asarray(leaf[i]), params))
+    return results
