@@ -17,7 +17,7 @@ import logging
 import sys
 from typing import List
 
-PHASES = ["training", "test_prio", "active_learning", "evaluation", "at_collection"]
+PHASES = ["training", "test_prio", "active_learning", "evaluation", "at_collection", "check"]
 CASE_STUDIES = ["mnist", "cifar10", "fmnist", "imdb"]
 EVALS = ["test_prio", "active_learning", "test_prio_statistics", "active_learning_statistics"]
 
@@ -75,6 +75,13 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO if args.verbose else logging.WARNING)
+
+    if args.phase == "check":
+        from simple_tip_tpu.utils.artifact_check import report
+
+        for cs_name in [args.case_study] if args.case_study else CASE_STUDIES:
+            print(report(cs_name, has_dropout=cs_name != "cifar10"))
+        return 0
 
     if args.phase == "evaluation":
         which = args.eval or "test_prio"

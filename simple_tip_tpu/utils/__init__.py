@@ -1,0 +1,5 @@
+"""Cross-cutting utilities: profiling hooks and artifact-bus checking."""
+
+from simple_tip_tpu.utils.profiling import maybe_trace
+
+__all__ = ["maybe_trace"]

@@ -1,0 +1,74 @@
+"""Artifact-bus completeness checking (failure detection).
+
+The reference's resilience model is idempotent, file-granular artifacts +
+restartable phases, with missing-run warnings at aggregation time (SURVEY.md
+section 5). This utility makes that proactive: scan the bus and report which
+(case study, run) pairs are missing which artifacts, so a partial/aborted
+sweep can be resumed with exactly the runs that need re-running.
+"""
+
+import os
+from typing import Dict, List, Set
+
+from simple_tip_tpu.config import output_folder
+from simple_tip_tpu.plotters.utils import APPROACHES
+
+
+def expected_priority_types(has_dropout: bool) -> List[str]:
+    """The artifact type-suffixes one complete prio run writes per dataset."""
+    types = ["is_misclassified"]
+    for unc in ["softmax", "pcs", "softmax_entropy", "deep_gini"] + (
+        ["VR"] if has_dropout else []
+    ):
+        types.append(f"uncertainty_{unc}")
+    for approach in APPROACHES:
+        if approach.endswith("-cam") or approach in (
+            "deep_gini",
+            "softmax",
+            "pcs",
+            "softmax_entropy",
+            "VR",
+        ):
+            continue
+        types.append(f"{approach}_scores")
+        types.append(f"{approach}_cam_order")
+    return types
+
+
+def check_prio_artifacts(
+    case_study: str, runs: range, has_dropout: bool = True
+) -> Dict[int, Set[str]]:
+    """Missing prio artifacts per run id (empty dict = complete)."""
+    prio = os.path.join(output_folder(), "priorities")
+    existing = set(os.listdir(prio)) if os.path.isdir(prio) else set()
+    missing: Dict[int, Set[str]] = {}
+    for run in runs:
+        for ds in ["nominal", "ood"]:
+            for t in expected_priority_types(has_dropout):
+                name = f"{case_study}_{ds}_{run}_{t}.npy"
+                if name not in existing:
+                    missing.setdefault(run, set()).add(name)
+    return missing
+
+
+def check_model_checkpoints(case_study: str, runs: range) -> List[int]:
+    """Run ids without a persisted model checkpoint."""
+    folder = os.path.join(output_folder(), "models", case_study)
+    existing = set(os.listdir(folder)) if os.path.isdir(folder) else set()
+    return [r for r in runs if f"{r}.msgpack" not in existing]
+
+
+def report(case_study: str, num_runs: int = 100, has_dropout: bool = True) -> str:
+    """Human-readable completeness report for one case study."""
+    lines = [f"artifact check: {case_study} (runs 0..{num_runs - 1})"]
+    missing_models = check_model_checkpoints(case_study, range(num_runs))
+    lines.append(
+        f"  models: {num_runs - len(missing_models)}/{num_runs} trained"
+        + (f" (missing: {missing_models[:10]}...)" if missing_models else "")
+    )
+    missing_prio = check_prio_artifacts(case_study, range(num_runs), has_dropout)
+    complete = num_runs - len(missing_prio)
+    lines.append(f"  prio artifacts: {complete}/{num_runs} runs complete")
+    for run, names in sorted(missing_prio.items())[:5]:
+        lines.append(f"    run {run}: {len(names)} artifacts missing")
+    return "\n".join(lines)

@@ -1,0 +1,25 @@
+"""Profiling hooks.
+
+The reference's only tracing is wall-clock Timers (SURVEY.md section 5); this
+build keeps that timing schema and adds optional XLA-level traces: set
+``TIP_PROFILE_DIR`` to capture a ``jax.profiler`` trace (viewable in
+TensorBoard / Perfetto) around any phase wrapped in ``maybe_trace``.
+"""
+
+import contextlib
+import os
+
+
+@contextlib.contextmanager
+def maybe_trace(label: str):
+    """Context manager: jax profiler trace when TIP_PROFILE_DIR is set."""
+    profile_dir = os.environ.get("TIP_PROFILE_DIR")
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    out = os.path.join(profile_dir, label)
+    os.makedirs(out, exist_ok=True)
+    with jax.profiler.trace(out):
+        yield

@@ -1,0 +1,35 @@
+"""Artifact-completeness checker tests."""
+
+import numpy as np
+
+
+def test_check_reports_missing(tmp_path, monkeypatch):
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path))
+    from simple_tip_tpu.utils.artifact_check import (
+        check_model_checkpoints,
+        check_prio_artifacts,
+        expected_priority_types,
+        report,
+    )
+
+    types = expected_priority_types(has_dropout=True)
+    assert "uncertainty_VR" in types
+    assert "NBC_0_scores" in types and "NBC_0_cam_order" in types
+    assert "uncertainty_VR" not in expected_priority_types(has_dropout=False)
+
+    # nothing exists -> everything missing
+    assert check_model_checkpoints("demo", range(3)) == [0, 1, 2]
+    missing = check_prio_artifacts("demo", range(2))
+    assert set(missing.keys()) == {0, 1}
+
+    # write one run's full artifact set -> run 0 complete
+    prio = tmp_path / "priorities"
+    prio.mkdir()
+    for ds in ["nominal", "ood"]:
+        for t in types:
+            np.save(prio / f"demo_{ds}_0_{t}.npy", np.zeros(1))
+    missing = check_prio_artifacts("demo", range(2))
+    assert set(missing.keys()) == {1}
+
+    text = report("demo", num_runs=2)
+    assert "1/2 runs complete" in text
