@@ -133,7 +133,11 @@ class CoverageWorker:
         self.setup_times[metric_id] = time_debit + timer.get()
 
     def _timed_activation_walk(self, test_dataset: np.ndarray):
-        activations_generator = self.base_model.walk_activations(test_dataset)
+        # device=True: profiles are computed by the jnp kernels on-device and
+        # only the boolean results are pulled to host for the spill files.
+        activations_generator = self.base_model.walk_activations(
+            test_dataset, device=True
+        )
         while True:
             try:
                 timer = Timer()

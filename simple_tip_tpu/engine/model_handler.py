@@ -123,16 +123,17 @@ class BaseModel:
                 batch_size=self.batch_size,
             )
 
-    def get_activations(self, x: np.ndarray) -> List[np.ndarray]:
-        """Deterministic forward returning the tapped layer activations."""
+    def get_activations(self, x: np.ndarray, device: bool = False) -> List[np.ndarray]:
+        """Deterministic forward returning the tapped layer activations
+        (``device=True`` keeps them as jax arrays for on-device consumers)."""
         self._ensure_taps_fn()
-        return self._taps_fn(self.params, x)
+        return self._taps_fn(self.params, x, device=device)
 
     def walk_activations(
-        self, x: np.ndarray, badge_size: Optional[int] = None
+        self, x: np.ndarray, badge_size: Optional[int] = None, device: bool = False
     ) -> Generator[List[np.ndarray], None, None]:
         """Stream activations badge-by-badge over a potentially large dataset."""
         self._ensure_taps_fn()
         badge_size = badge_size or self.batch_size
         for start in range(0, x.shape[0], badge_size):
-            yield self._taps_fn(self.params, x[start : start + badge_size])
+            yield self._taps_fn(self.params, x[start : start + badge_size], device=device)

@@ -187,13 +187,17 @@ def _make_taps_fn_cached(
             outs.append(probs)
         return outs
 
-    def get_activations(params, x: np.ndarray):
+    def get_activations(params, x: np.ndarray, device: bool = False):
+        """Tapped activations; ``device=True`` returns jax arrays (keeps the
+        downstream metric kernels on device instead of host numpy)."""
         n = x.shape[0]
         chunks = []
         for start in range(0, n, batch_size):
             xb = jnp.asarray(x[start : start + batch_size])
-            chunks.append([np.asarray(o) for o in fwd(params, xb)])
-        return [np.concatenate([c[i] for c in chunks], axis=0) for i in range(len(chunks[0]))]
+            outs = fwd(params, xb)
+            chunks.append(outs if device else [np.asarray(o) for o in outs])
+        cat = jnp.concatenate if device else np.concatenate
+        return [cat([c[i] for c in chunks], axis=0) for i in range(len(chunks[0]))]
 
     return get_activations
 
