@@ -20,24 +20,36 @@ from simple_tip_tpu.config import output_folder
 from simple_tip_tpu.engine.model_handler import BaseModel
 from simple_tip_tpu.ops.coverage import KMNC, NAC, NBC, SNAC, TKNC, CoverageMethod
 from simple_tip_tpu.ops.prioritizers import cam
-from simple_tip_tpu.ops.stats import AggregateStatisticsCollector
 from simple_tip_tpu.ops.timer import Timer
 
 
 class CoverageWorker:
-    """Efficiently handles the 12 configured neuron-coverage instances."""
+    """Efficiently handles the 12 configured neuron-coverage instances.
 
-    def __init__(self, base_model: BaseModel, training_set: np.ndarray):
+    ``spill``: where test-set profiles live between the badge pass and CAM.
+    "memory" keeps them in host RAM (no disk I/O — the TPU-native default
+    when RAM allows), "disk" reproduces the reference's temp-npy spill,
+    "auto" picks by available memory.
+    """
+
+    def __init__(
+        self, base_model: BaseModel, training_set: np.ndarray, spill: str = "auto"
+    ):
+        from simple_tip_tpu.ops.stats import DeviceAggregateStatisticsCollector
+
         self.base_model = base_model
         self.metrics: Dict[str, CoverageMethod] = {}
         self.setup_times: Dict[str, float] = {}
         self.training_set = training_set
+        self.spill = spill
+        self._mem_profiles: Dict[str, list] = {}
+        self._mem_scores: Dict[str, list] = {}
         # Random token avoids temp-dir collisions between concurrent runs.
         self.temp_random = str(secrets.token_urlsafe(16))
 
-        agg_stats = AggregateStatisticsCollector()
+        agg_stats = DeviceAggregateStatisticsCollector()
         pred_timer = Timer(start=True)
-        for activations in base_model.walk_activations(training_set):
+        for activations in base_model.walk_activations(training_set, device=True):
             pred_timer.stop()
             agg_stats.track(activations)
             pred_timer.start()
@@ -147,11 +159,35 @@ class CoverageWorker:
             except StopIteration:
                 return
 
+    def _resolve_spill(self, test_dataset: np.ndarray) -> str:
+        if self.spill != "auto":
+            return self.spill
+        try:
+            import psutil
+
+            available = psutil.virtual_memory().available
+        except ImportError:  # pragma: no cover
+            return "disk"
+        # Rough per-sample profile footprint across all configured metrics:
+        # one bool per (neuron, section).
+        sample = self.base_model.get_activations(test_dataset[:1])
+        neurons = sum(int(np.prod(a.shape[1:])) for a in sample)
+        sections = {"NBC": 2, "KMNC": 2}
+        per_sample = sum(
+            neurons * sections.get(mid.split("_")[0], 1) for mid in self.metrics
+        )
+        estimate = per_sample * test_dataset.shape[0]
+        return "memory" if estimate * 2 < available else "disk"
+
     def _prepare_profiles(self, test_dataset: np.ndarray, ds_id, times):
-        for metric_id in self.metrics.keys():
-            shutil.rmtree(self._get_temp_path(metric_id), ignore_errors=True)
-            os.makedirs(os.path.join(self._get_temp_path(metric_id), f"{ds_id}-scores"))
-            os.makedirs(os.path.join(self._get_temp_path(metric_id), f"{ds_id}-profiles"))
+        mode = self._resolve_spill(test_dataset)
+        self._mem_profiles = {m: [] for m in self.metrics}
+        self._mem_scores = {m: [] for m in self.metrics}
+        if mode == "disk":
+            for metric_id in self.metrics.keys():
+                shutil.rmtree(self._get_temp_path(metric_id), ignore_errors=True)
+                os.makedirs(os.path.join(self._get_temp_path(metric_id), f"{ds_id}-scores"))
+                os.makedirs(os.path.join(self._get_temp_path(metric_id), f"{ds_id}-profiles"))
 
         for b, (activations, pred_time) in enumerate(
             self._timed_activation_walk(test_dataset)
@@ -163,14 +199,22 @@ class CoverageWorker:
                     s, p = np.asarray(s), np.asarray(p)
                 times[metric_id][1] += pred_time
                 times[metric_id][2] += timer.get()
-                np.save(
-                    os.path.join(self._get_temp_path(metric_id), f"{ds_id}-scores", f"{b}.npy"),
-                    s,
-                )
-                np.save(
-                    os.path.join(self._get_temp_path(metric_id), f"{ds_id}-profiles", f"{b}.npy"),
-                    p,
-                )
+                if mode == "memory":
+                    self._mem_scores[metric_id].append(s)
+                    self._mem_profiles[metric_id].append(p)
+                else:
+                    np.save(
+                        os.path.join(
+                            self._get_temp_path(metric_id), f"{ds_id}-scores", f"{b}.npy"
+                        ),
+                        s,
+                    )
+                    np.save(
+                        os.path.join(
+                            self._get_temp_path(metric_id), f"{ds_id}-profiles", f"{b}.npy"
+                        ),
+                        p,
+                    )
 
     @staticmethod
     def _concatenate_arrays_in_folder(folder: str) -> np.ndarray:
@@ -182,6 +226,13 @@ class CoverageWorker:
         return np.concatenate(arrays, axis=0)
 
     def _load_prepared_profile(self, metric_id: str, ds_id, delete: bool = True):
+        if self._mem_profiles.get(metric_id):
+            scores = np.concatenate(self._mem_scores[metric_id], axis=0)
+            profiles = np.concatenate(self._mem_profiles[metric_id], axis=0)
+            if delete:
+                self._mem_scores[metric_id] = []
+                self._mem_profiles[metric_id] = []
+            return scores, profiles
         folder = self._get_temp_path(metric_id)
         scores = self._concatenate_arrays_in_folder(os.path.join(folder, f"{ds_id}-scores"))
         profiles = self._concatenate_arrays_in_folder(

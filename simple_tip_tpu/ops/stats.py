@@ -101,6 +101,98 @@ class AggregateStatisticsCollector:
         return self.mins, self.maxs, stds
 
 
+class DeviceAggregateStatisticsCollector:
+    """Streaming per-neuron min/max/std computed on device.
+
+    Same interface and output as ``AggregateStatisticsCollector`` (including
+    the min/max/welford timer attributes consumed by the coverage worker's
+    time-debit accounting), but each badge folds into the running statistics
+    as one fused jitted program per layer — no host float64 passes. Because
+    the three statistics are fused, their measured device time is attributed
+    equally to the three timers (a documented approximation; the reference
+    times them separately on host).
+    """
+
+    def __init__(self):
+        self.done = False
+        self._state = None  # per-layer (min, max, count, mean, m2)
+        self.min_timer = Timer()
+        self.max_timer = Timer()
+        self.welford_timer = Timer()
+        self._fused_elapsed = 0.0
+
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def init_layer(b):
+            flat = b.reshape(b.shape[0], -1).astype(jnp.float32)
+            mean = flat.mean(axis=0)
+            return (
+                b.min(axis=0),
+                b.max(axis=0),
+                b.shape[0],
+                mean,
+                ((flat - mean) ** 2).sum(axis=0),
+            )
+
+        @jax.jit
+        def update_layer(state, b):
+            mn, mx, cnt, mean, m2 = state
+            flat = b.reshape(b.shape[0], -1).astype(jnp.float32)
+            b_cnt = b.shape[0]
+            b_mean = flat.mean(axis=0)
+            b_m2 = ((flat - b_mean) ** 2).sum(axis=0)
+            delta = b_mean - mean
+            total = cnt + b_cnt
+            return (
+                jnp.minimum(mn, b.min(axis=0)),
+                jnp.maximum(mx, b.max(axis=0)),
+                total,
+                mean + delta * (b_cnt / total),
+                m2 + b_m2 + delta**2 * (cnt * b_cnt / total),
+            )
+
+        self._init_layer = init_layer
+        self._update_layer = update_layer
+
+    def track(self, badge) -> None:
+        """Fold the next badge of per-layer (jax or numpy) arrays in."""
+        if self.done:
+            raise RuntimeError(
+                "`get` has been called. calling it multiple times falsifies timer."
+            )
+        import jax
+        import jax.numpy as jnp
+        import time as _time
+
+        t0 = _time.time()
+        badge = [jnp.asarray(b) for b in badge]
+        if self._state is None:
+            self._state = [self._init_layer(b) for b in badge]
+        else:
+            self._state = [
+                self._update_layer(s, b) for s, b in zip(self._state, badge)
+            ]
+        jax.block_until_ready([s[0] for s in self._state])
+        self._fused_elapsed += _time.time() - t0
+
+    def get(self) -> AggStats:
+        """Return (mins, maxs, stds) per layer (host numpy)."""
+        import jax.numpy as jnp
+
+        third = self._fused_elapsed / 3.0
+        for t in (self.min_timer, self.max_timer, self.welford_timer):
+            t._elapsed += third
+        mins = [np.asarray(s[0]) for s in self._state]
+        maxs = [np.asarray(s[1]) for s in self._state]
+        stds = [
+            np.asarray(jnp.sqrt(s[4] / (np.asarray(s[2]) - 1)).reshape(s[0].shape))
+            for s in self._state
+        ]
+        return mins, maxs, stds
+
+
 def aggregate_over_batches(layer_batches_iter):
     """Fused device path: iterate (list-of-layer-arrays) badges, compute
     min/max/Welford on device via jnp, return host numpy (mins, maxs, stds).

@@ -1,0 +1,28 @@
+"""Device streaming-statistics collector must match the host collector."""
+
+import numpy as np
+
+from simple_tip_tpu.ops.stats import (
+    AggregateStatisticsCollector,
+    DeviceAggregateStatisticsCollector,
+)
+from tests.test_stats import _badges
+
+
+def test_device_collector_matches_host():
+    rng = np.random.default_rng(3)
+    badges = _badges(rng)
+    host = AggregateStatisticsCollector()
+    dev = DeviceAggregateStatisticsCollector()
+    for b in badges:
+        host.track(b)
+        dev.track(b)
+    h_mins, h_maxs, h_stds = host.get()
+    d_mins, d_maxs, d_stds = dev.get()
+    for i in range(len(h_mins)):
+        np.testing.assert_allclose(d_mins[i], h_mins[i], rtol=1e-5)
+        np.testing.assert_allclose(d_maxs[i], h_maxs[i], rtol=1e-5)
+        np.testing.assert_allclose(d_stds[i], h_stds[i], rtol=1e-3, atol=1e-5)
+    # fused time attributed across the three timers
+    assert dev.min_timer.get() > 0
+    assert abs(dev.min_timer.get() - dev.welford_timer.get()) < 1e-9
