@@ -18,9 +18,31 @@ import numpy as np
 
 from simple_tip_tpu.config import output_folder
 from simple_tip_tpu.engine.model_handler import BaseModel
-from simple_tip_tpu.ops.coverage import KMNC, NAC, NBC, SNAC, TKNC, CoverageMethod
-from simple_tip_tpu.ops.prioritizers import cam
+from simple_tip_tpu.ops.coverage import (
+    KMNC,
+    NAC,
+    NBC,
+    SNAC,
+    TKNC,
+    CoverageMethod,
+    make_fused_profile_fn,
+)
+from simple_tip_tpu.ops.prioritizers import cam_order
 from simple_tip_tpu.ops.timer import Timer
+
+PROFILE_BADGE_SIZE = 512
+
+
+def _cam_from_packed(scores: np.ndarray, packed: np.ndarray, bit_len: int) -> np.ndarray:
+    """CAM order from packed profiles: native popcount kernel when available,
+    else unpack and run the generic path."""
+    try:
+        from simple_tip_tpu.ops.native import cam_order_packed
+
+        return cam_order_packed(scores, packed, bit_len)
+    except (ImportError, OSError):
+        profiles = np.unpackbits(packed, axis=1, count=bit_len).astype(bool)
+        return cam_order(scores, profiles)
 
 
 class CoverageWorker:
@@ -44,12 +66,16 @@ class CoverageWorker:
         self.spill = spill
         self._mem_profiles: Dict[str, list] = {}
         self._mem_scores: Dict[str, list] = {}
+        self._fused_fn = None
+        self._bit_len = None
         # Random token avoids temp-dir collisions between concurrent runs.
         self.temp_random = str(secrets.token_urlsafe(16))
 
         agg_stats = DeviceAggregateStatisticsCollector()
         pred_timer = Timer(start=True)
-        for activations in base_model.walk_activations(training_set, device=True):
+        for activations in base_model.walk_activations(
+            training_set, badge_size=PROFILE_BADGE_SIZE, device=True
+        ):
             pred_timer.stop()
             agg_stats.track(activations)
             pred_timer.start()
@@ -109,17 +135,19 @@ class CoverageWorker:
 
         self._prepare_profiles(test_dataset, ds_id=test_dataset_id, times=times)
         for metric_id in self.metrics.keys():
-            scores, profiles = self._load_prepared_profile(
+            scores, packed, bit_len = self._load_prepared_profile(
                 metric_id=metric_id, ds_id=test_dataset_id, delete=True
             )
             all_scores[metric_id] = scores
 
             timer = Timer()
             with timer:
-                cam_orders[metric_id] = [i for i in cam(scores=scores, profiles=profiles)]
+                cam_orders[metric_id] = list(
+                    _cam_from_packed(scores, packed, bit_len)
+                )
             times[metric_id].append(timer.get())
             self._cam_sanity_check(cam_orders[metric_id], scores)
-            del profiles
+            del packed
         return times, all_scores, cam_orders
 
     def _get_temp_path(self, metric_id: str) -> str:
@@ -146,9 +174,11 @@ class CoverageWorker:
 
     def _timed_activation_walk(self, test_dataset: np.ndarray):
         # device=True: profiles are computed by the jnp kernels on-device and
-        # only the boolean results are pulled to host for the spill files.
+        # only the packed results are pulled to host. The walk badge is larger
+        # than the reference's prediction badge — on TPU, per-dispatch latency
+        # dominates tiny badges.
         activations_generator = self.base_model.walk_activations(
-            test_dataset, device=True
+            test_dataset, badge_size=PROFILE_BADGE_SIZE, device=True
         )
         while True:
             try:
@@ -173,16 +203,21 @@ class CoverageWorker:
         sample = self.base_model.get_activations(test_dataset[:1])
         neurons = sum(int(np.prod(a.shape[1:])) for a in sample)
         sections = {"NBC": 2, "KMNC": 2}
-        per_sample = sum(
+        per_sample_bits = sum(
             neurons * sections.get(mid.split("_")[0], 1) for mid in self.metrics
         )
-        estimate = per_sample * test_dataset.shape[0]
+        estimate = per_sample_bits // 8 * test_dataset.shape[0]
         return "memory" if estimate * 2 < available else "disk"
 
     def _prepare_profiles(self, test_dataset: np.ndarray, ds_id, times):
+        """One fused device dispatch per badge computes ALL metrics' scores and
+        bit-packed profiles; packed bytes (8x smaller than bool) accumulate in
+        RAM or spill to disk."""
         mode = self._resolve_spill(test_dataset)
         self._mem_profiles = {m: [] for m in self.metrics}
         self._mem_scores = {m: [] for m in self.metrics}
+        if self._fused_fn is None:
+            self._fused_fn, self._bit_len = make_fused_profile_fn(self.metrics)
         if mode == "disk":
             for metric_id in self.metrics.keys():
                 shutil.rmtree(self._get_temp_path(metric_id), ignore_errors=True)
@@ -192,13 +227,17 @@ class CoverageWorker:
         for b, (activations, pred_time) in enumerate(
             self._timed_activation_walk(test_dataset)
         ):
-            for metric_id, metric in self.metrics.items():
-                timer = Timer()
-                with timer:
-                    s, p = metric(activations)
-                    s, p = np.asarray(s), np.asarray(p)
+            timer = Timer()
+            with timer:
+                fused_out = self._fused_fn(activations)
+                fused_out = {
+                    mid: (np.asarray(s), np.asarray(p))
+                    for mid, (s, p) in fused_out.items()
+                }
+            quant_time = timer.get() / len(self.metrics)
+            for metric_id, (s, p) in fused_out.items():
                 times[metric_id][1] += pred_time
-                times[metric_id][2] += timer.get()
+                times[metric_id][2] += quant_time
                 if mode == "memory":
                     self._mem_scores[metric_id].append(s)
                     self._mem_profiles[metric_id].append(p)
@@ -226,18 +265,19 @@ class CoverageWorker:
         return np.concatenate(arrays, axis=0)
 
     def _load_prepared_profile(self, metric_id: str, ds_id, delete: bool = True):
+        """Returns (scores, packed_profiles, bit_len)."""
         if self._mem_profiles.get(metric_id):
             scores = np.concatenate(self._mem_scores[metric_id], axis=0)
-            profiles = np.concatenate(self._mem_profiles[metric_id], axis=0)
+            packed = np.concatenate(self._mem_profiles[metric_id], axis=0)
             if delete:
                 self._mem_scores[metric_id] = []
                 self._mem_profiles[metric_id] = []
-            return scores, profiles
+            return scores, packed, self._bit_len(metric_id)
         folder = self._get_temp_path(metric_id)
         scores = self._concatenate_arrays_in_folder(os.path.join(folder, f"{ds_id}-scores"))
-        profiles = self._concatenate_arrays_in_folder(
+        packed = self._concatenate_arrays_in_folder(
             os.path.join(folder, f"{ds_id}-profiles")
         )
         if delete:
             shutil.rmtree(folder, ignore_errors=True)
-        return scores, profiles
+        return scores, packed, self._bit_len(metric_id)

@@ -133,6 +133,41 @@ class SNAC(CoverageMethod):
         return sum_score(profiles), profiles
 
 
+def make_fused_profile_fn(metrics: dict):
+    """Fuse all configured coverage metrics into ONE jitted device program.
+
+    Returns ``(fn, bit_lens)`` where ``fn(activations) -> {metric_id:
+    (scores, packed_profiles)}`` computes every metric's scores and
+    bit-packed boolean profiles in a single dispatch (one XLA program per
+    badge instead of one per metric — critical when device round-trips are
+    expensive), and ``bit_lens[mid]`` is the unpacked per-sample bit count
+    (packbits pads rows to a byte boundary).
+
+    Profiles are packed MSB-first (numpy ``packbits`` layout), directly
+    consumable by the packed C++ CAM kernel or ``np.unpackbits``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    bit_lens = {}
+
+    @jax.jit
+    def fused(activations):
+        out = {}
+        for mid, metric in metrics.items():
+            s, p = metric(activations)
+            flat = p.reshape((p.shape[0], -1))
+            # static at trace time; records the unpadded bit width
+            bit_lens[mid] = int(flat.shape[1])
+            out[mid] = (s, jnp.packbits(flat, axis=1))
+        return out
+
+    def get_bit_len(mid: str) -> int:
+        return bit_lens[mid]
+
+    return fused, get_bit_len
+
+
 class TKNC(CoverageMethod):
     """Top-K Neuron Coverage: per layer, bit set for the k highest-activated
     neurons of each sample (reference: src/core/neuron_coverage.py:147-167)."""

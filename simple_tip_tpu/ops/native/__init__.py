@@ -41,6 +41,14 @@ def _load() -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int64),
     ]
+    lib.cam_greedy_packed.restype = ctypes.c_int64
+    lib.cam_greedy_packed.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
     lib.lev_matrix.restype = None
     lib.lev_matrix.argtypes = [
         ctypes.c_char_p,
@@ -77,6 +85,29 @@ def cam_native(scores: np.ndarray, profiles: np.ndarray) -> np.ndarray:
         prof.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         n,
         m,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    picked = out[:n_picked]
+    scores = np.asarray(scores, dtype=np.float64).copy()
+    min_score = scores.min() - 1
+    scores[picked] = min_score - 1
+    rest = np.argsort(-scores)
+    rest = rest[~(scores[rest] < min_score)]
+    return np.concatenate([picked, rest.astype(np.int64)])
+
+
+def cam_order_packed(scores: np.ndarray, packed: np.ndarray, m_bits: int) -> np.ndarray:
+    """Full CAM order from numpy-packbits profile rows (n x nbytes uint8):
+    C++ popcount greedy picks + numpy score-ordered remainder."""
+    lib = _load()
+    packed = np.ascontiguousarray(packed, dtype=np.uint8)
+    n, nbytes = packed.shape
+    out = np.empty(n, dtype=np.int64)
+    n_picked = lib.cam_greedy_packed(
+        packed.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n,
+        nbytes,
+        int(m_bits),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     picked = out[:n_picked]

@@ -67,6 +67,71 @@ int64_t cam_greedy(const uint8_t* profiles, int64_t n, int64_t m, int64_t* out) 
     return n_picked;
 }
 
+// Packed-bit variant: profiles are row-major n x nbytes uint8 bitfields
+// (MSB-first within a byte, numpy packbits layout; trailing pad bits zero).
+// Same greedy semantics as cam_greedy, but membership counting is popcount
+// over the bytes that gained new coverage — 8x denser memory traffic and
+// ~8-64x fewer ops on the wide profile matrices of the real case studies.
+int64_t cam_greedy_packed(const uint8_t* profiles, int64_t n, int64_t nbytes,
+                          int64_t m_bits, int64_t* out) {
+    static const auto popcount_row = [](const uint8_t* row, int64_t nbytes) {
+        int64_t s = 0;
+        int64_t i = 0;
+        for (; i + 8 <= nbytes; i += 8) {
+            uint64_t w;
+            std::memcpy(&w, row + i, 8);
+            s += __builtin_popcountll(w);
+        }
+        for (; i < nbytes; ++i) s += __builtin_popcount(row[i]);
+        return s;
+    };
+
+    std::vector<int64_t> num_coverable(n);
+    for (int64_t i = 0; i < n; ++i)
+        num_coverable[i] = popcount_row(profiles + i * nbytes, nbytes);
+
+    std::vector<uint8_t> covered(nbytes, 0);
+    std::vector<uint8_t> newly(nbytes, 0);
+    std::vector<int64_t> active;  // byte indices with new coverage this pick
+    active.reserve(256);
+    int64_t remaining = m_bits;
+    int64_t n_picked = 0;
+    while (true) {
+        int64_t best = 0;
+        int64_t best_val = num_coverable[0];
+        for (int64_t i = 1; i < n; ++i) {
+            if (num_coverable[i] > best_val) {
+                best_val = num_coverable[i];
+                best = i;
+            }
+        }
+        if (best_val == 0) break;
+        out[n_picked++] = best;
+
+        const uint8_t* row = profiles + best * nbytes;
+        active.clear();
+        int64_t newly_bits = 0;
+        for (int64_t b = 0; b < nbytes; ++b) {
+            uint8_t nb = row[b] & static_cast<uint8_t>(~covered[b]);
+            newly[b] = nb;
+            if (nb) {
+                active.push_back(b);
+                newly_bits += __builtin_popcount(nb);
+            }
+        }
+        for (int64_t i = 0; i < n; ++i) {
+            const uint8_t* r = profiles + i * nbytes;
+            int64_t cnt = 0;
+            for (int64_t b : active) cnt += __builtin_popcount(r[b] & newly[b]);
+            num_coverable[i] -= cnt;
+        }
+        for (int64_t b : active) covered[b] |= newly[b];
+        remaining -= newly_bits;
+        if (remaining <= 0) break;
+    }
+    return n_picked;
+}
+
 static inline int lev(const char* a, int la, const char* b, int lb,
                       std::vector<int>& dp) {
     // single-row DP

@@ -124,8 +124,7 @@ class DeviceAggregateStatisticsCollector:
         import jax
         import jax.numpy as jnp
 
-        @jax.jit
-        def init_layer(b):
+        def _one_init(b):
             flat = b.reshape(b.shape[0], -1).astype(jnp.float32)
             mean = flat.mean(axis=0)
             return (
@@ -136,8 +135,7 @@ class DeviceAggregateStatisticsCollector:
                 ((flat - mean) ** 2).sum(axis=0),
             )
 
-        @jax.jit
-        def update_layer(state, b):
+        def _one_update(state, b):
             mn, mx, cnt, mean, m2 = state
             flat = b.reshape(b.shape[0], -1).astype(jnp.float32)
             b_cnt = b.shape[0]
@@ -153,8 +151,13 @@ class DeviceAggregateStatisticsCollector:
                 m2 + b_m2 + delta**2 * (cnt * b_cnt / total),
             )
 
-        self._init_layer = init_layer
-        self._update_layer = update_layer
+        # One fused dispatch per badge over the whole layer list.
+        self._init_layer = jax.jit(lambda badge: [_one_init(b) for b in badge])
+        self._update_layer = jax.jit(
+            lambda state, badge: [
+                _one_update(s, b) for s, b in zip(state, badge)
+            ]
+        )
 
     def track(self, badge) -> None:
         """Fold the next badge of per-layer (jax or numpy) arrays in."""
@@ -169,11 +172,9 @@ class DeviceAggregateStatisticsCollector:
         t0 = _time.time()
         badge = [jnp.asarray(b) for b in badge]
         if self._state is None:
-            self._state = [self._init_layer(b) for b in badge]
+            self._state = self._init_layer(badge)
         else:
-            self._state = [
-                self._update_layer(s, b) for s, b in zip(self._state, badge)
-            ]
+            self._state = self._update_layer(self._state, badge)
         jax.block_until_ready([s[0] for s in self._state])
         self._fused_elapsed += _time.time() - t0
 
