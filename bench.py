@@ -29,6 +29,10 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from simple_tip_tpu.config import enable_compilation_cache
+
+    enable_compilation_cache()
+
     from simple_tip_tpu.models import MnistConvNet
     from simple_tip_tpu.models.train import init_params
     from simple_tip_tpu.ops.uncertainty import (

@@ -76,6 +76,10 @@ def main(argv=None) -> int:
 
     logging.basicConfig(level=logging.INFO if args.verbose else logging.WARNING)
 
+    from simple_tip_tpu.config import enable_compilation_cache
+
+    enable_compilation_cache()
+
     if args.phase == "check":
         from simple_tip_tpu.utils.artifact_check import report
 

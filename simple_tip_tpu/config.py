@@ -37,4 +37,22 @@ def subdir(name: str) -> str:
     return path
 
 
+def enable_compilation_cache() -> None:
+    """Turn on JAX's persistent compilation cache (idempotent).
+
+    The experiment phases re-launch the same XLA programs across runs and
+    process restarts (the phases are restartable by design, SURVEY.md section
+    5 checkpoint/resume); caching compiled executables under ``TIP_JAX_CACHE``
+    (default ``./.jax_cache``) removes recompiles on every entry point.
+    Disable with ``TIP_JAX_CACHE=off``.
+    """
+    cache = os.environ.get("TIP_JAX_CACHE", os.path.join(os.getcwd(), ".jax_cache"))
+    if cache.lower() == "off":
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
 MAX_NUM_MODELS = 100

@@ -7,7 +7,10 @@ environments with no network egress, so every loader:
 
 1. looks for cached arrays under ``TIP_DATA_DIR`` (same npy naming as the
    reference where one exists: ``mnist_c_images.npy`` etc.);
-2. otherwise falls back to a *deterministic synthetic stand-in* with identical
+2. when nominal data IS present but the corrupted companion set is not,
+   generates an MNIST-C / CIFAR-10-C style corrupted set on the spot with the
+   jitted corruption kernels in ``image_corruptor`` and caches it;
+3. otherwise falls back to a *deterministic synthetic stand-in* with identical
    shapes/dtypes/class structure (loudly warned) so every pipeline phase runs
    end-to-end anywhere. Synthetic sets are learnable-but-not-trivial:
    class-dependent spatial/token patterns plus noise, with a corrupted OOD

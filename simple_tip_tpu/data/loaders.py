@@ -45,6 +45,14 @@ def _npz_path(name: str) -> Optional[str]:
     return path if os.path.exists(path) else None
 
 
+def _atomic_save(path: str, array: np.ndarray) -> None:
+    """Write an npy atomically (temp file + rename) so an interrupted run
+    never leaves a truncated file that later loads would trip over."""
+    tmp = path + ".tmp.npy"  # ends in .npy so np.save keeps the name as-is
+    np.save(tmp, array)
+    os.replace(tmp, path)
+
+
 def _warn_synthetic(name: str):
     logger.warning(
         "Dataset %s not found under %s — falling back to a DETERMINISTIC "
@@ -83,9 +91,51 @@ def _load_image_case(name: str, shape, synth_seed: int, scale_uint8: bool) -> Tr
                 x_corr = x_corr[..., None]
             y_corr = np.load(c_lab).astype(np.int64).flatten()
         else:
-            logger.warning("%s corruption cache missing — corrupting synthetically", name)
-            x_corr = synthetic.corrupt_images(x_test, seed=synth_seed)
-            y_corr = y_test.copy()
+            # Generate the MNIST-C / CIFAR-10-C style corrupted set offline
+            # (the reference downloads these; we synthesize them with the
+            # jitted corruption kernels) and cache it in the loader's format.
+            from simple_tip_tpu.data import image_corruptor
+
+            logger.warning(
+                "%s corruption cache missing — generating a %s-style corrupted "
+                "set with simple_tip_tpu.data.image_corruptor (cached for reuse)",
+                name,
+                "CIFAR-10-C" if name == "cifar10" else "MNIST-C",
+            )
+            make = (
+                image_corruptor.cifar10_c_like
+                if name == "cifar10"
+                else image_corruptor.mnist_c_like
+            )
+            x_corr, y_corr = make(x_test, y_test, seed=synth_seed)
+            if scale_uint8:
+                quantized = np.round(x_corr * 255.0).astype(np.uint8)
+                to_cache = quantized
+                x_corr = quantized.astype("float32") / 255.0
+            else:
+                to_cache = x_corr
+            if c_img is not None or c_lab is not None:
+                # Exactly one of the two cache files exists — likely a real
+                # downloaded set with a missing/misnamed companion. Never
+                # overwrite it with generated data; use the in-memory set.
+                logger.error(
+                    "%s corruption cache is INCOMPLETE (images: %s, labels: %s)"
+                    " — refusing to overwrite; using generated set in-memory."
+                    " Fix or remove the existing file to enable caching.",
+                    name,
+                    c_img or "missing",
+                    c_lab or "missing",
+                )
+            else:
+                try:
+                    _atomic_save(
+                        os.path.join(data_folder(), f"{name}_c_images.npy"), to_cache
+                    )
+                    _atomic_save(
+                        os.path.join(data_folder(), f"{name}_c_labels.npy"), y_corr
+                    )
+                except OSError as e:  # read-only dataset volume: keep in-memory set
+                    logger.warning("could not cache %s corrupted set (%s)", name, e)
     else:
         _warn_synthetic(name)
         (x_train, y_train), (x_test, y_test) = synthetic.image_classification(

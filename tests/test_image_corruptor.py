@@ -1,0 +1,109 @@
+"""Tests for the MNIST-C / CIFAR-10-C style image corruption generator.
+
+Mirrors the reference's test styles for its (text) corruptor — determinism,
+severity monotonicity, invariants (SURVEY.md section 4) — applied to the image
+corruption kernels. Small images keep jit compiles cheap.
+"""
+
+import numpy as np
+import pytest
+
+from simple_tip_tpu.data import image_corruptor as ic
+
+
+def _images(n=6, h=16, w=16, c=1, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0.1, 0.6, size=(n, h, w, c)).astype(np.float32)
+    # localized bright stamp so geometric/edge corruptions have structure
+    x[:, 4:9, 4:9, :] = 0.95
+    return x
+
+
+ALL_KINDS = sorted(ic.CORRUPTIONS)
+
+
+@pytest.mark.parametrize("kind", ALL_KINDS)
+def test_shape_range_and_determinism(kind):
+    x = _images()
+    a = ic.corrupt_images(x, kind, severity=3, seed=7)
+    b = ic.corrupt_images(x, kind, severity=3, seed=7)
+    assert a.shape == x.shape and a.dtype == np.float32
+    assert np.all(a >= 0.0) and np.all(a <= 1.0)
+    np.testing.assert_array_equal(a, b)
+    # severity 5 must also be valid
+    a5 = ic.corrupt_images(x, kind, severity=5, seed=7)
+    assert np.all(a5 >= 0.0) and np.all(a5 <= 1.0)
+
+
+@pytest.mark.parametrize("kind", ALL_KINDS)
+def test_actually_changes_images(kind):
+    x = _images(c=3 if kind == "saturate" else 1)
+    out = ic.corrupt_images(x, kind, severity=4, seed=3)
+    assert np.abs(out - x).mean() > 1e-4, f"{kind} left images untouched"
+
+
+@pytest.mark.parametrize(
+    "kind", ["gaussian_noise", "impulse_noise", "brightness", "contrast", "rotate"]
+)
+def test_severity_monotone(kind):
+    """Mean distortion grows with severity (metamorphic relation, as the
+    reference asserts for its text corruptor severity)."""
+    x = _images(n=16)
+    d = [
+        np.abs(ic.corrupt_images(x, kind, severity=s, seed=1) - x).mean()
+        for s in (1, 3, 5)
+    ]
+    assert d[0] < d[1] < d[2], d
+
+
+def test_subset_independence():
+    """Corrupting a subset at the same global indices equals slicing the
+    full-set result (per-image fold_in keys)."""
+    x = _images(n=8)
+    full = ic.corrupt_images(x, "gaussian_noise", severity=3, seed=5)
+    sub = ic.corrupt_images(
+        x[2:5], "gaussian_noise", severity=3, seed=5, global_indices=[2, 3, 4]
+    )
+    np.testing.assert_array_equal(full[2:5], sub)
+
+
+def test_seed_changes_noise():
+    x = _images()
+    a = ic.corrupt_images(x, "shot_noise", severity=3, seed=0)
+    b = ic.corrupt_images(x, "shot_noise", severity=3, seed=1)
+    assert np.abs(a - b).max() > 1e-4
+
+
+def test_canny_is_binary():
+    x = _images()
+    out = ic.corrupt_images(x, "canny_edges", severity=3, seed=0)
+    assert set(np.unique(out)).issubset({0.0, 1.0})
+
+
+def test_corrupted_test_set_shapes_and_determinism():
+    x = _images(n=20)
+    y = np.arange(20) % 10
+    kinds = ("gaussian_noise", "brightness", "stripe")
+    cx, cy = ic.corrupted_test_set(x, y, kinds, total=12, seed=0)
+    cx2, cy2 = ic.corrupted_test_set(x, y, kinds, total=12, seed=0)
+    assert cx.shape == (12, 16, 16, 1) and cy.shape == (12,)
+    np.testing.assert_array_equal(cx, cx2)
+    np.testing.assert_array_equal(cy, cy2)
+    # labels must correspond to source images (label-preserving corruption)
+    assert set(cy).issubset(set(y))
+
+
+def test_kind_registries_cover_reference_sets():
+    """The MNIST-C and CIFAR-10-C kind lists are complete and implemented."""
+    assert len(ic.MNIST_C_KINDS) == 15
+    assert len(ic.CIFAR10_C_KINDS) == 15
+    for k in ic.MNIST_C_KINDS + ic.CIFAR10_C_KINDS:
+        assert k in ic.CORRUPTIONS
+
+
+def test_color_images_supported():
+    x = _images(n=4, h=16, w=16, c=3)
+    out = ic.corrupt_images(x, "jpeg_compression", severity=3, seed=0)
+    assert out.shape == x.shape
+    out2 = ic.corrupt_images(x, "elastic_transform", severity=3, seed=0)
+    assert out2.shape == x.shape

@@ -1,0 +1,97 @@
+"""Loader tests: real-npz path with generated corruption caches, synthetic
+fallback path, and the OOD-mix construction contract."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def data_dir(tmp_path, monkeypatch):
+    d = tmp_path / "datasets"
+    d.mkdir()
+    monkeypatch.setenv("TIP_DATA_DIR", str(d))
+    # loaders are lru_cached per process; clear around each test
+    from simple_tip_tpu.data import loaders
+
+    for fn in (loaders.load_mnist, loaders.load_fmnist, loaders.load_cifar10, loaders.load_imdb):
+        fn.cache_clear()
+    yield d
+    for fn in (loaders.load_mnist, loaders.load_fmnist, loaders.load_cifar10, loaders.load_imdb):
+        fn.cache_clear()
+
+
+def _write_tiny_mnist_npz(path, n_train=24, n_test=10, hw=16):
+    rng = np.random.default_rng(0)
+    np.savez(
+        path,
+        x_train=rng.integers(0, 256, size=(n_train, hw, hw), dtype=np.uint8),
+        y_train=rng.integers(0, 10, size=n_train).astype(np.int64),
+        x_test=rng.integers(0, 256, size=(n_test, hw, hw), dtype=np.uint8),
+        y_test=rng.integers(0, 10, size=n_test).astype(np.int64),
+    )
+
+
+def test_npz_path_generates_and_caches_corrupted_set(data_dir):
+    from simple_tip_tpu.data import loaders
+
+    _write_tiny_mnist_npz(os.path.join(str(data_dir), "mnist.npz"))
+    (x_train, y_train), (x_test, y_test), (ood_x, ood_y) = loaders.load_mnist()
+
+    assert x_train.shape == (24, 16, 16, 1) and x_train.dtype == np.float32
+    assert 0.0 <= x_train.min() and x_train.max() <= 1.0
+    # OOD set = nominal + corrupted, shuffled: twice the test size
+    assert ood_x.shape == (20, 16, 16, 1) and ood_y.shape == (20,)
+    # corruption cache written in the reference's naming (uint8 for mnist)
+    c_img = os.path.join(str(data_dir), "mnist_c_images.npy")
+    c_lab = os.path.join(str(data_dir), "mnist_c_labels.npy")
+    assert os.path.exists(c_img) and os.path.exists(c_lab)
+    assert np.load(c_img).dtype == np.uint8
+
+    # a reload (fresh cache) must reproduce the same OOD set from the files
+    loaders.load_mnist.cache_clear()
+    _, _, (ood_x2, ood_y2) = loaders.load_mnist()
+    np.testing.assert_array_equal(ood_x, ood_x2)
+    np.testing.assert_array_equal(ood_y, ood_y2)
+
+
+def test_incomplete_cache_is_never_overwritten(data_dir):
+    """With only one of the two corruption-cache files present (e.g. a real
+    downloaded set with a misnamed companion), the loader must generate
+    in-memory and refuse to touch the existing file."""
+    from simple_tip_tpu.data import loaders
+
+    _write_tiny_mnist_npz(os.path.join(str(data_dir), "mnist.npz"))
+    lab_path = os.path.join(str(data_dir), "mnist_c_labels.npy")
+    sentinel = np.arange(7, dtype=np.int64)
+    np.save(lab_path, sentinel)
+
+    (_, _), (x_test, _), (ood_x, _) = loaders.load_mnist()
+    assert ood_x.shape[0] == 2 * x_test.shape[0]  # generated set still used
+    np.testing.assert_array_equal(np.load(lab_path), sentinel)  # untouched
+    assert not os.path.exists(os.path.join(str(data_dir), "mnist_c_images.npy"))
+
+
+def test_synthetic_fallback_shapes(data_dir):
+    from simple_tip_tpu.data import loaders
+
+    (x_train, y_train), (x_test, y_test), (ood_x, ood_y) = loaders.load_mnist()
+    assert x_train.shape[1:] == (28, 28, 1)
+    assert ood_x.shape[0] == 2 * x_test.shape[0]
+    assert set(np.unique(y_train)).issubset(set(range(10)))
+
+
+def test_ood_mix_is_seeded_and_complete(data_dir):
+    from simple_tip_tpu.data.loaders import _ood_mix
+
+    x_test = np.arange(8, dtype=np.float32).reshape(8, 1)
+    y_test = np.arange(8)
+    x_corr = x_test + 100
+    ood_x, ood_y = _ood_mix(x_test, y_test, x_corr, y_test, seed=0)
+    ood_x2, ood_y2 = _ood_mix(x_test, y_test, x_corr, y_test, seed=0)
+    np.testing.assert_array_equal(ood_x, ood_x2)
+    # every nominal and corrupted sample appears exactly once
+    assert sorted(ood_x.ravel().tolist()) == sorted(
+        x_test.ravel().tolist() + (x_test + 100).ravel().tolist()
+    )
