@@ -76,10 +76,6 @@ def main(argv=None) -> int:
 
     logging.basicConfig(level=logging.INFO if args.verbose else logging.WARNING)
 
-    from simple_tip_tpu.config import enable_compilation_cache
-
-    enable_compilation_cache()
-
     if args.phase == "check":
         from simple_tip_tpu.utils.artifact_check import report
 
@@ -96,6 +92,11 @@ def main(argv=None) -> int:
     if not args.case_study:
         parser.error("--case-study is required for non-evaluation phases")
     runs = _parse_runs(args.runs)
+
+    # jax-using phases only (check/evaluation above stay jax-free and fast)
+    from simple_tip_tpu.config import enable_compilation_cache
+
+    enable_compilation_cache()
 
     from simple_tip_tpu.casestudies import get_case_study
 

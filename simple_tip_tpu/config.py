@@ -46,7 +46,8 @@ def enable_compilation_cache() -> None:
     (default ``./.jax_cache``) removes recompiles on every entry point.
     Disable with ``TIP_JAX_CACHE=off``.
     """
-    cache = os.environ.get("TIP_JAX_CACHE", os.path.join(os.getcwd(), ".jax_cache"))
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cache = os.environ.get("TIP_JAX_CACHE", os.path.join(repo_root, ".jax_cache"))
     if cache.lower() == "off":
         return
     import jax

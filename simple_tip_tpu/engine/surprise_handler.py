@@ -123,10 +123,15 @@ class SurpriseHandler:
                 sa_pred, times = res[sa_name][ds_name]
                 cam_timer = Timer()
                 with cam_timer:
-                    # Upper bound chosen dynamically from the observed max.
-                    coverage_mapper = SurpriseCoverageMapper(
-                        NUM_SC_BUCKETS, np.max(sa_pred)
-                    )
+                    # Upper bound chosen dynamically from the observed max —
+                    # the FINITE max: LSA yields +inf for all samples when the
+                    # KDE degrades to zero densities (ops/kde.py "failing
+                    # silently" mode), and linspace(0, inf) would produce
+                    # all-NaN bucket thresholds. Non-finite SA values then
+                    # simply fall outside every bucket.
+                    finite = np.asarray(sa_pred)[np.isfinite(sa_pred)]
+                    upper = float(finite.max()) if finite.size else 1.0
+                    coverage_mapper = SurpriseCoverageMapper(NUM_SC_BUCKETS, upper)
                     coverage_profiles = coverage_mapper.get_coverage_profile(sa_pred)
                     cam_order = [i for i in cam(sa_pred, coverage_profiles)]
                 cam_order = np.array(cam_order)

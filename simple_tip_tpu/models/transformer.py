@@ -14,11 +14,13 @@ embedding/FFN sublayers which its own membership test silently ignores
 *effective* behavior: only integer taps 3 and 5 participate in NC.
 """
 
-from typing import Dict, Tuple
+import functools
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
 
 glorot = nn.initializers.glorot_uniform()
 
@@ -47,24 +49,97 @@ class TokenAndPositionEmbedding(nn.Module):
         return tok + pos
 
 
+class RingSelfAttention(nn.Module):
+    """Self-attention whose core runs as sequence-parallel ring attention.
+
+    Long-context path: Q/K/V projections are local; the attention core is the
+    exact streaming-softmax ring over the ``seq_axis`` of ``ring_mesh``
+    (parallel/ring_attention.py), so sequences can exceed one device's memory.
+    With ``ring_mesh=None`` the same parameters run through the dense oracle
+    core — enabling single-device use and equivalence testing.
+    """
+
+    num_heads: int
+    qkv_features: int
+    out_features: int
+    ring_mesh: Optional[Mesh] = None
+    seq_axis: str = "sp"
+
+    @nn.compact
+    def __call__(self, x):
+        from simple_tip_tpu.parallel.ring_attention import (
+            ring_attention,
+            ring_self_attention_reference,
+        )
+
+        head_dim = self.qkv_features // self.num_heads
+        proj = functools.partial(
+            nn.DenseGeneral, features=(self.num_heads, head_dim), kernel_init=glorot
+        )
+        q = proj(name="query")(x)
+        k = proj(name="key")(x)
+        v = proj(name="value")(x)
+        if self.ring_mesh is not None:
+            from simple_tip_tpu.parallel.ring_attention import check_ring_divisibility
+
+            check_ring_divisibility(x.shape[1], self.ring_mesh.shape[self.seq_axis])
+            spec = P(None, self.seq_axis, None, None)
+            core = jax.shard_map(
+                functools.partial(
+                    ring_attention,
+                    axis_name=self.seq_axis,
+                    n_dev=self.ring_mesh.shape[self.seq_axis],
+                ),
+                mesh=self.ring_mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+            )
+            out = core(q, k, v)
+        else:
+            out = ring_self_attention_reference(q, k, v)
+        return nn.DenseGeneral(
+            features=self.out_features, axis=(-2, -1), kernel_init=glorot, name="out"
+        )(out)
+
+
 class TransformerBlock(nn.Module):
-    """Post-LN transformer encoder block, Keras-tutorial style."""
+    """Post-LN transformer encoder block, Keras-tutorial style.
+
+    ``attention_impl``: "dense" (default, Keras-parity MHA) or "ring"
+    (sequence-parallel ring attention over ``ring_mesh``).
+    """
 
     embed_dim: int
     num_heads: int
     ff_dim: int
     rate: float = 0.1
+    attention_impl: str = "dense"
+    ring_mesh: Optional[Mesh] = None
+    seq_axis: str = "sp"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         # Keras MultiHeadAttention(key_dim=embed_dim) uses *per-head* dim
         # embed_dim => total qkv features = num_heads * embed_dim.
-        attn = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads,
-            qkv_features=self.num_heads * self.embed_dim,
-            out_features=self.embed_dim,
-            kernel_init=glorot,
-        )(x, x)
+        if self.attention_impl not in ("dense", "ring"):
+            raise ValueError(
+                f"unknown attention_impl {self.attention_impl!r}; use 'dense' or 'ring'"
+            )
+        if self.attention_impl == "ring":
+            attn = RingSelfAttention(
+                num_heads=self.num_heads,
+                qkv_features=self.num_heads * self.embed_dim,
+                out_features=self.embed_dim,
+                ring_mesh=self.ring_mesh,
+                seq_axis=self.seq_axis,
+            )(x)
+        else:
+            attn = nn.MultiHeadDotProductAttention(
+                num_heads=self.num_heads,
+                qkv_features=self.num_heads * self.embed_dim,
+                out_features=self.embed_dim,
+                kernel_init=glorot,
+            )(x, x)
         attn = nn.Dropout(self.rate, deterministic=not train)(attn)
         out1 = nn.LayerNorm(epsilon=1e-6)(x + attn)
         ffn = nn.Dense(self.ff_dim, kernel_init=glorot)(out1)
@@ -75,7 +150,12 @@ class TransformerBlock(nn.Module):
 
 
 class ImdbTransformer(nn.Module):
-    """2-class IMDB sentiment classifier with Keras-index taps."""
+    """2-class IMDB sentiment classifier with Keras-index taps.
+
+    ``attention_impl="ring"`` (+ ``ring_mesh``) switches the encoder block to
+    sequence-parallel ring attention for long-context scaling; the default
+    "dense" path is the reference-parity architecture.
+    """
 
     vocab_size: int = 2000
     maxlen: int = 100
@@ -83,6 +163,9 @@ class ImdbTransformer(nn.Module):
     num_heads: int = 2
     ff_dim: int = 32
     num_classes: int = 2
+    attention_impl: str = "dense"
+    ring_mesh: Optional[Mesh] = None
+    seq_axis: str = "sp"
 
     has_dropout = True
     sa_layers = (5,)
@@ -95,7 +178,14 @@ class ImdbTransformer(nn.Module):
         taps: Dict[int, jnp.ndarray] = {}
         h = TokenAndPositionEmbedding(self.maxlen, self.vocab_size, self.embed_dim)(x)
         taps[1] = h
-        h = TransformerBlock(self.embed_dim, self.num_heads, self.ff_dim)(h, train)
+        h = TransformerBlock(
+            self.embed_dim,
+            self.num_heads,
+            self.ff_dim,
+            attention_impl=self.attention_impl,
+            ring_mesh=self.ring_mesh,
+            seq_axis=self.seq_axis,
+        )(h, train)
         taps[2] = h
         h = jnp.mean(h, axis=1)  # GlobalAveragePooling1D
         taps[3] = h

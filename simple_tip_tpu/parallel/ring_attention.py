@@ -75,11 +75,25 @@ def ring_self_attention_reference(q, k, v):
     return jnp.einsum("bhqd->bqhd", out)
 
 
+def check_ring_divisibility(seq_len: int, n_dev: int) -> None:
+    """Reject sequence lengths that don't shard evenly: JAX would silently
+    pad the shards, and padded K/V rows (all-zero keys, score 0) leak weight
+    into the streaming softmax — a subtle numerical corruption, observed as
+    ~1e-3 output error instead of an exception."""
+    if seq_len % n_dev != 0:
+        raise ValueError(
+            f"ring attention requires the sequence length ({seq_len}) to be "
+            f"divisible by the sequence-parallel mesh size ({n_dev}); pad the "
+            f"sequence or choose a different mesh"
+        )
+
+
 def ring_attention_sharded(
     q: np.ndarray, k: np.ndarray, v: np.ndarray, mesh: Mesh, axis: str = "sp"
 ):
     """Run ring attention with the sequence axis of q/k/v sharded over
     ``axis`` of ``mesh``. Host-convenience wrapper around shard_map."""
+    check_ring_divisibility(q.shape[1], mesh.shape[axis])
     spec = P(None, axis, None, None)
     fn = jax.shard_map(
         functools.partial(ring_attention, axis_name=axis, n_dev=mesh.shape[axis]),

@@ -31,8 +31,8 @@ def _public_defs(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
                 if not node.name.startswith("_"):
                     yield node
-                if isinstance(node, ast.ClassDef):
-                    yield from scoped(node.body)
+                    if isinstance(node, ast.ClassDef):
+                        yield from scoped(node.body)
 
     yield from scoped(tree.body)
 

@@ -26,7 +26,7 @@ def _tiny_case_study():
 
     def loader():
         (x_train, y_train), (x_test, y_test) = synthetic.image_classification(
-            seed=5, n_train=240, n_test=120, shape=(16, 16, 1), num_classes=4
+            seed=5, n_train=192, n_test=96, shape=(16, 16, 1), num_classes=4
         )
         x_corr = synthetic.corrupt_images(x_test, seed=6, severity=0.6)
         ood_x = np.concatenate([x_test, x_corr])
@@ -38,7 +38,7 @@ def _tiny_case_study():
         name="tinymnist",
         model_factory=lambda: MnistConvNet(num_classes=4),
         loader=loader,
-        train_cfg=TrainConfig(batch_size=32, epochs=3, validation_split=0.1),
+        train_cfg=TrainConfig(batch_size=32, epochs=2, learning_rate=5e-3, validation_split=0.1),
         nc_activation_layers=(0, 1, 2, 3),
         sa_activation_layers=(3,),
         prediction_badge_size=64,

@@ -28,10 +28,12 @@ def test_stack_init_members_differ():
 
 
 def test_train_ensemble_learns_on_mesh():
+    # Small images + few epochs + raised lr: vmapped conv training executes
+    # pathologically slowly on XLA:CPU, and this is the suite's hottest test.
     rng = np.random.default_rng(0)
-    x, labels, y = _toy_data(rng, n=192)
+    x, labels, y = _toy_data(rng, n=128, hw=12)
     model = MnistConvNet(num_classes=4)
-    cfg = TrainConfig(batch_size=32, epochs=4, validation_split=0.1)
+    cfg = TrainConfig(batch_size=32, epochs=3, learning_rate=5e-3, validation_split=0.1)
     mesh = ensemble_mesh(n_ensemble=4, n_data=2)
     stacked = train_ensemble(model, x, y, cfg, seeds=[0, 1, 2], mesh=mesh)
 

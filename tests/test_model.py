@@ -19,12 +19,14 @@ from simple_tip_tpu.models.train import (
 )
 
 
-def _toy_data(rng, n=256, num_classes=4):
-    """Linearly separable blobs rendered into 28x28x1 'images'."""
+def _toy_data(rng, n=256, num_classes=4, hw=28):
+    """Linearly separable blobs rendered into hw x hw x 1 'images'."""
     labels = rng.integers(0, num_classes, size=n)
-    x = rng.normal(0.1, 0.05, size=(n, 28, 28, 1)).astype(np.float32)
+    x = rng.normal(0.1, 0.05, size=(n, hw, hw, 1)).astype(np.float32)
+    band = max(1, (hw - 4) // (2 * num_classes))
     for i, l in enumerate(labels):
-        x[i, 2 + 5 * l : 6 + 5 * l, 5:20, 0] += 0.9
+        r = 1 + band * int(l)
+        x[i, r : r + band, 2 : hw - 2, 0] += 0.9
     y = np.eye(num_classes, dtype=np.float32)[labels]
     return x, labels, y
 
