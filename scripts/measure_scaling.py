@@ -1,0 +1,120 @@
+"""Measure ensemble-training throughput at real case-study scale on the
+available accelerator, and print the extrapolated wall-clock for the full
+100-run study (BASELINE.md north-star: < 24 h on a v4-32, vs the reference's
+"multiple weeks" on a multi-GPU box).
+
+Method: time one epoch of each case study's model at its real data scale and
+batch size (the AL retrain unit, reference:
+src/dnn_test_prio/eval_active_learning.py:161-180) for growing vmapped
+ensemble group sizes G. Per-model epoch time shrinks with G until the chip
+saturates. The full-study estimate is then, per case study,
+
+    runs x (train_epochs + retrains_per_run x retrain_epochs) x
+    per_model_epoch(best G) / chips
+
+summed over case studies (training-phase + AL-phase; the prioritization phase
+is forward-pass-dominated and adds minutes, not hours).
+
+Usage: python scripts/measure_scaling.py [--groups 1,4,8] [--chips 16]
+       [--case-studies mnist,fmnist,cifar10,imdb]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RETRAINS_PER_RUN = 80  # ~40 selections x {nominal, ood}
+RUNS = 100
+
+
+def _case_study_specs():
+    from simple_tip_tpu.models import Cifar10ConvNet, ImdbTransformer, MnistConvNet
+
+    def img(n, hw, c):
+        rng = np.random.default_rng(0)
+        x = rng.normal(0.2, 0.25, size=(n, hw, hw, c)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=n)]
+        return x, y
+
+    def tokens(n, seq):
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 2000, size=(n, seq)).astype(np.int32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, size=n)]
+        return x, y
+
+    # (model, data, batch_size, epochs) — reference hyperparameters
+    # (SURVEY.md section 2.2 D10-D13), n = 0.9 * train set size.
+    return {
+        "mnist": (MnistConvNet(), img(54000, 28, 1), 128, 15),
+        "fmnist": (MnistConvNet(), img(54000, 28, 1), 128, 15),
+        "cifar10": (Cifar10ConvNet(), img(45000, 32, 3), 32, 20),
+        "imdb": (ImdbTransformer(num_classes=2), tokens(22500, 100), 32, 10),
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--groups", default="1,4,8")
+    parser.add_argument("--chips", type=int, default=16, help="v4-32 = 16 chips")
+    parser.add_argument("--case-studies", default="mnist,fmnist,cifar10,imdb")
+    args = parser.parse_args()
+
+    import jax
+
+    from simple_tip_tpu.config import enable_compilation_cache
+    from simple_tip_tpu.models.train import TrainConfig
+    from simple_tip_tpu.parallel import train_ensemble
+
+    enable_compilation_cache()
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})")
+
+    specs = _case_study_specs()
+    groups = [int(s) for s in args.groups.split(",")]
+    total_hours = 0.0
+    summary = {}
+    for cs in args.case_studies.split(","):
+        if cs not in specs:
+            parser.error(f"unknown case study {cs!r}; choose from {sorted(specs)}")
+        model, (x, y), batch, epochs = specs[cs]
+        best = None
+        for g in groups:
+            cfg = TrainConfig(batch_size=batch, epochs=1, validation_split=0.1)
+            # compile + drain the device queue before timing
+            jax.block_until_ready(train_ensemble(model, x, y, cfg, seeds=list(range(g))))
+            t0 = time.perf_counter()
+            out = train_ensemble(model, x, y, cfg, seeds=list(range(g)))
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            per_model = dt / g
+            best = min(best, per_model) if best is not None else per_model
+            print(
+                f"{cs:8s} G={g:3d}: epoch {dt:6.2f}s  per-model {per_model:6.3f}s  "
+                f"({len(x) * g / dt:,.0f} samples/s)"
+            )
+        cs_hours = (
+            RUNS * (epochs + RETRAINS_PER_RUN * epochs) * best / args.chips / 3600
+        )
+        summary[cs] = {"per_model_epoch_s": round(best, 3), "study_hours": round(cs_hours, 2)}
+        total_hours += cs_hours
+
+    print(
+        json.dumps(
+            {
+                "chips": args.chips,
+                "per_case_study": summary,
+                "full_study_hours_train_plus_al": round(total_hours, 2),
+                "note": "prioritization phase is forward-dominated (adds minutes)",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,7 @@ Determinism fix-with-flag: the reference's retrain shuffle is unseeded
 ``deterministic=False``.
 """
 
+import logging
 import os
 import pickle
 from typing import Callable, Dict, List, Optional, Tuple
@@ -28,6 +29,8 @@ from simple_tip_tpu.config import subdir
 from simple_tip_tpu.engine.coverage_handler import CoverageWorker
 from simple_tip_tpu.engine.model_handler import BaseModel
 from simple_tip_tpu.engine.surprise_handler import SurpriseHandler
+
+logger = logging.getLogger(__name__)
 
 RANDOM_SPLIT = "random"
 
@@ -83,6 +86,20 @@ def evaluate(
         ood_test_labels,
         observed_share=observed_share,
     )
+
+    smallest_observed = min(
+        len(x) for (_, split), (x, _) in active_datasets.items() if split == OBS
+    )
+    if num_selected > smallest_observed:
+        # Smoke-test-sized datasets can't supply the configured selection
+        # size; clamp with a loud warning instead of tripping the sanity
+        # check downstream. Real case-study data is never in this regime.
+        logger.warning(
+            "num_selected=%d exceeds the smallest observed split (%d) — clamping",
+            num_selected,
+            smallest_observed,
+        )
+        num_selected = smallest_observed
 
     original_model_eval = _evaluate(model_def, params, active_datasets, accuracy_fn)
 

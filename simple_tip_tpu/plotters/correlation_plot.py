@@ -104,6 +104,18 @@ class WilcoxonCorrelationPlot:
         from matplotlib.colors import LogNorm
 
         values = self.calc_values()
+        finite_p = values["p"][np.isfinite(values["p"]) & (values["p"] < 10000)]
+        if finite_p.size == 0 or (finite_p <= 0).all():
+            # Too little data for any valid p-value (e.g. a single-run smoke
+            # pipeline): LogNorm would reject its vmin/vmax. CSVs are already
+            # written by the callers; skip only the figure.
+            import warnings
+
+            warnings.warn(
+                f"no finite positive p-values for {exp} ({cs}, {ds}) — "
+                "skipping heatmap figure"
+            )
+            return
         matrix_0 = np.triu(values["e"].transpose())
         error_corrected_p = self.error_correction(values["p"])
         matrix_1 = np.tril(error_corrected_p)

@@ -51,6 +51,35 @@ def check_prio_artifacts(
     return missing
 
 
+def check_al_artifacts(
+    case_study: str, runs: range, has_dropout: bool = True
+) -> Dict[int, int]:
+    """Missing active-learning pickles per run id (empty dict = complete).
+
+    One complete AL run writes 40 selections x {nominal, ood} + 1 original
+    evaluation (reference: src/dnn_test_prio/eval_active_learning.py:97-147);
+    the VR selection exists only for models with dropout layers.
+    """
+    al = os.path.join(output_folder(), "active_learning")
+    existing = set(os.listdir(al)) if os.path.isdir(al) else set()
+    approaches = [a for a in APPROACHES if has_dropout or a != "VR"]
+    expected_names = ["original_na"] + [
+        f"{approach}_{oodnom}"
+        for approach in approaches + ["random"]
+        for oodnom in ("nominal", "ood")
+    ]
+    missing: Dict[int, int] = {}
+    for run in runs:
+        n = sum(
+            1
+            for name in expected_names
+            if f"{case_study}_{run}_{name}.pickle" not in existing
+        )
+        if n:
+            missing[run] = n
+    return missing
+
+
 def check_model_checkpoints(case_study: str, runs: range) -> List[int]:
     """Run ids without a persisted model checkpoint."""
     folder = os.path.join(output_folder(), "models", case_study)
@@ -71,4 +100,10 @@ def report(case_study: str, num_runs: int = 100, has_dropout: bool = True) -> st
     lines.append(f"  prio artifacts: {complete}/{num_runs} runs complete")
     for run, names in sorted(missing_prio.items())[:5]:
         lines.append(f"    run {run}: {len(names)} artifacts missing")
+    missing_al = check_al_artifacts(case_study, range(num_runs), has_dropout)
+    lines.append(
+        f"  active-learning artifacts: {num_runs - len(missing_al)}/{num_runs} runs complete"
+    )
+    for run, n in sorted(missing_al.items())[:5]:
+        lines.append(f"    run {run}: {n} pickles missing")
     return "\n".join(lines)
