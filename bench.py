@@ -30,8 +30,10 @@ def main():
     import jax.numpy as jnp
 
     from simple_tip_tpu.config import enable_compilation_cache
+    from simple_tip_tpu.utils.device_watchdog import ensure_responsive_backend
 
     enable_compilation_cache()
+    ensure_responsive_backend()
 
     from simple_tip_tpu.models import MnistConvNet
     from simple_tip_tpu.models.train import init_params

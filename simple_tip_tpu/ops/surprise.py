@@ -72,7 +72,7 @@ def _subsample_arrays(subsampling, arrays: Tuple[np.ndarray, ...], seed: int):
     assert all(
         a.shape[0] == array_lengths for a in arrays
     ), "All arrays must have the same number of samples"
-    if subsampling == 1.0:
+    if subsampling is None or subsampling == 1.0:
         return arrays
     elif isinstance(subsampling, int) and subsampling > 0:
         num_samples = min(subsampling, array_lengths)
