@@ -1,0 +1,373 @@
+"""Cross-validation against the reference implementation as a live oracle.
+
+The reference's pure-numpy metric kernels (APFD, CTM/CAM, the five
+neuron-coverage criteria, stable KDE, LSA/MDSA/DSA, the surprise-coverage
+mapper) are importable without TF/uncertainty-wizard.  When the reference
+tree is present (``/root/reference``, or ``$TIP_REFERENCE_DIR``), these tests
+feed *identical random inputs* to both implementations and require matching
+outputs — a much stronger parity proof than hand-picked oracles, because the
+inputs are adversarially arbitrary and regenerated per seed.
+
+When the reference tree is absent (e.g. running the suite standalone), the
+whole module skips; the hand-derived oracles in the sibling test files keep
+covering behavior.
+
+No reference code is copied here — it is imported at test time only, as an
+executable specification (reference: src/core/apfd.py, prioritizers.py,
+neuron_coverage.py, stable_kde.py, surprise.py).
+"""
+
+import os
+import pathlib
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+REFERENCE_DIR = pathlib.Path(os.environ.get("TIP_REFERENCE_DIR", "/root/reference"))
+
+pytestmark = pytest.mark.skipif(
+    not (REFERENCE_DIR / "src" / "core").is_dir(),
+    reason="reference implementation not available to act as oracle",
+)
+
+
+@pytest.fixture(scope="module")
+def ref():
+    """Import the reference core modules (numpy-only, no TF) as the oracle.
+
+    The reference targets numpy 1.x / scipy 1.7 (its requirements.txt); two
+    environment shims make it runnable under the modern stack WITHOUT changing
+    its behavior: the removed ``np.int``/``np.bool`` aliases, and modern
+    scipy's read-only ``gaussian_kde.inv_cov`` property (the reference's
+    ``_compute_covariance`` assigns it; shadowing the property with a plain
+    class attribute restores 1.7 assignment semantics)."""
+    had_int, had_bool = hasattr(np, "int"), hasattr(np, "bool")
+    if not had_int:
+        np.int = int
+    if not had_bool:
+        np.bool = bool
+    sys.path.insert(0, str(REFERENCE_DIR))
+    try:
+        import src.core.apfd as ref_apfd
+        import src.core.neuron_coverage as ref_nc
+        import src.core.prioritizers as ref_prio
+        import src.core.stable_kde as ref_kde
+        import src.core.surprise as ref_surprise
+    finally:
+        sys.path.remove(str(REFERENCE_DIR))
+    if isinstance(getattr(ref_kde.StableGaussianKDE, "inv_cov", None), property):
+        ref_kde.StableGaussianKDE.inv_cov = None
+    # Modern scipy's evaluate() consumes `cho_cov`, which scipy 1.7's
+    # _compute_covariance contract (what the reference implements) never set.
+    # Derive it from the reference's own stabilized covariance so scipy's
+    # kernel evaluation runs on exactly the oracle's matrix.
+    _ref_compute = ref_kde.StableGaussianKDE._compute_covariance
+
+    def _compute_covariance_with_cho(self):
+        _ref_compute(self)
+        if not getattr(self, "prepare_failed", False) and hasattr(self, "covariance"):
+            self.cho_cov = np.linalg.cholesky(self.covariance).astype(np.float64)
+
+    ref_kde.StableGaussianKDE._compute_covariance = _compute_covariance_with_cho
+    yield {
+        "apfd": ref_apfd,
+        "nc": ref_nc,
+        "prio": ref_prio,
+        "kde": ref_kde,
+        "surprise": ref_surprise,
+    }
+    if not had_int:
+        del np.int
+    if not had_bool:
+        del np.bool
+
+
+# ---------------------------------------------------------------------------
+# APFD
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_apfd_matches_reference(ref, seed):
+    from simple_tip_tpu.ops.apfd import apfd_from_order
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(5, 400))
+    is_fault = (rng.random(n) < rng.uniform(0.05, 0.9)).astype(np.int64)
+    if is_fault.sum() == 0:
+        is_fault[int(rng.integers(0, n))] = 1
+    order = rng.permutation(n)
+    ours = apfd_from_order(is_fault, order)
+    theirs = ref["apfd"].apfd_from_order(is_fault, order)
+    assert ours == pytest.approx(theirs, abs=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# CTM / CAM prioritizers
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_ctm_matches_reference(ref, seed):
+    from simple_tip_tpu.ops.prioritizers import ctm
+
+    rng = np.random.default_rng(seed)
+    # include heavy ties to pin down tie-breaking parity
+    scores = rng.integers(0, 7, size=int(rng.integers(3, 500))).astype(np.float64)
+    assert list(ctm(scores)) == list(ref["prio"].ctm(scores))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_cam_matches_reference(ref, seed):
+    """Full greedy CAM order parity on random scores + boolean profiles.
+
+    Exercises our native C++ popcount CAM (with numpy fallback) against the
+    reference's per-step greedy loop, including the leftover-samples-by-score
+    tail once coverage is saturated."""
+    from simple_tip_tpu.ops.prioritizers import cam_order
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(5, 300))
+    width = int(rng.integers(1, 80))
+    density = rng.uniform(0.02, 0.6)
+    profiles = rng.random((n, width)) < density
+    scores = rng.integers(0, 5, size=n).astype(np.float64)
+    ours = list(cam_order(scores, profiles))
+    theirs = list(ref["prio"].cam(scores, profiles))
+    assert ours == theirs
+
+
+# ---------------------------------------------------------------------------
+# Neuron-coverage criteria
+# ---------------------------------------------------------------------------
+
+
+def _random_activation_layers(rng, n):
+    """Random multi-layer activation lists like a transparent-model output."""
+    shapes = [(n, int(rng.integers(2, 9))) for _ in range(int(rng.integers(1, 4)))]
+    return [rng.normal(size=s).astype(np.float64) * 3 for s in shapes]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_nc_criteria_match_reference(ref, seed):
+    import simple_tip_tpu.ops.coverage as ours
+
+    rng = np.random.default_rng(100 + seed)
+    n = int(rng.integers(4, 60))
+    train = _random_activation_layers(rng, int(rng.integers(20, 80)))
+    test = [rng.normal(size=(n,) + t.shape[1:]).astype(np.float64) * 3 for t in train]
+    mins = [t.min(axis=0) for t in train]
+    maxs = [t.max(axis=0) for t in train]
+    stds = [t.std(axis=0) for t in train]
+
+    pairs = [
+        (ours.NAC(0.0), ref["nc"].NAC(0.0)),
+        (ours.NAC(0.75), ref["nc"].NAC(0.75)),
+        (ours.KMNC(mins, maxs, 2), ref["nc"].KMNC(mins, maxs, 2)),
+        (ours.NBC(mins, maxs, stds, 0.0), ref["nc"].NBC(mins, maxs, stds, 0.0)),
+        (ours.NBC(mins, maxs, stds, 1.0), ref["nc"].NBC(mins, maxs, stds, 1.0)),
+        (ours.SNAC(maxs, stds, 0.5), ref["nc"].SNAC(maxs, stds, 0.5)),
+        (ours.TKNC(1), ref["nc"].TKNC(1)),
+        (ours.TKNC(3), ref["nc"].TKNC(3)),
+    ]
+    for mine, oracle in pairs:
+        my_scores, my_profiles = mine(test)
+        ref_scores, ref_profiles = oracle(test)
+        np.testing.assert_allclose(
+            np.asarray(my_scores, np.float64),
+            np.asarray(ref_scores, np.float64),
+            rtol=1e-6,
+            err_msg=f"{type(mine).__name__} scores diverge",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(my_profiles),
+            np.asarray(ref_profiles),
+            err_msg=f"{type(mine).__name__} profiles diverge",
+        )
+
+
+# ---------------------------------------------------------------------------
+# Stable KDE + LSA
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_stable_kde_matches_reference(ref, seed):
+    from simple_tip_tpu.ops.kde import StableGaussianKDE
+
+    rng = np.random.default_rng(200 + seed)
+    d, n = int(rng.integers(2, 8)), int(rng.integers(40, 120))
+    data = rng.normal(size=(d, n))
+    points = rng.normal(size=(d, 25))
+    ours = StableGaussianKDE(data).evaluate(points)
+    theirs = ref["kde"].StableGaussianKDE(data).evaluate(points)
+    np.testing.assert_allclose(ours, theirs, rtol=1e-9)
+
+
+def test_stable_kde_degenerate_matches_reference(ref):
+    """A rank-deficient dataset must fail-soft identically (all-zero)."""
+    from simple_tip_tpu.ops.kde import StableGaussianKDE
+
+    rng = np.random.default_rng(7)
+    base = rng.normal(size=(1, 50))
+    data = np.vstack([base, base * 2.0, base * -1.0])  # rank 1, 3 dims
+    points = rng.normal(size=(3, 10))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ours = StableGaussianKDE(data).evaluate(points)
+        theirs = ref["kde"].StableGaussianKDE(data).evaluate(points)
+    np.testing.assert_allclose(ours, theirs)
+    assert np.all(ours == 0.0)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_lsa_matches_reference(ref, seed):
+    from simple_tip_tpu.ops.surprise import LSA
+
+    rng = np.random.default_rng(300 + seed)
+    f = int(rng.integers(3, 12))
+    train = [rng.normal(size=(150, f)) * rng.uniform(0.5, 3.0, size=f)]
+    test = [rng.normal(size=(40, f)) * 2]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ours = LSA(train)(test)
+        theirs = ref["surprise"].LSA(train)(test)
+    np.testing.assert_allclose(ours, theirs, rtol=1e-8)
+
+
+def test_lsa_feature_pruning_matches_reference(ref):
+    """max_features variance pruning must select (and order) the same columns."""
+    from simple_tip_tpu.ops.surprise import LSA
+
+    rng = np.random.default_rng(42)
+    f = 30
+    scale = rng.uniform(0.01, 5.0, size=f)
+    train = [rng.normal(size=(200, f)) * scale]
+    test = [rng.normal(size=(50, f)) * scale]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ours = LSA(train, max_features=8)(test)
+        theirs = ref["surprise"].LSA(train, max_features=8)(test)
+    np.testing.assert_allclose(ours, theirs, rtol=1e-8)
+
+
+# ---------------------------------------------------------------------------
+# MDSA / DSA / MultiModalSA / SurpriseCoverageMapper
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_mdsa_matches_reference(ref, seed):
+    from simple_tip_tpu.ops.surprise import MDSA
+
+    rng = np.random.default_rng(400 + seed)
+    f = int(rng.integers(2, 10))
+    train = [rng.normal(size=(120, f))]
+    test = [rng.normal(size=(40, f)) * 2]
+    ours = np.asarray(MDSA(train)(test), np.float64)
+    theirs = np.asarray(ref["surprise"].MDSA(train)(test), np.float64)
+    # ours runs float32 on device; the oracle is float64 sklearn
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3)
+    # ordering (what APFD consumes) must agree exactly
+    assert list(np.argsort(-ours)) == list(np.argsort(-theirs))
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_dsa_matches_reference(ref, seed):
+    from simple_tip_tpu.ops.surprise import DSA
+
+    rng = np.random.default_rng(500 + seed)
+    f = int(rng.integers(3, 16))
+    n_train, n_test, n_classes = 160, 50, int(rng.integers(2, 5))
+    train = [rng.normal(size=(n_train, f))]
+    train_pred = rng.integers(0, n_classes, size=n_train)
+    test = [rng.normal(size=(n_test, f)) * 1.5]
+    test_pred = rng.integers(0, n_classes, size=n_test)
+    ours = np.asarray(DSA(train, train_pred, badge_size=7)(test, test_pred))
+    theirs = np.asarray(
+        ref["surprise"].DSA(train, train_pred, badge_size=7)(test, test_pred)
+    )
+    np.testing.assert_allclose(ours, theirs, rtol=1e-3)
+
+
+def test_dsa_subsampling_matches_reference(ref):
+    """The 30% train-subsample path (used by the pc-dsa config) must pick the
+    same rows, so scores match despite the randomized subsample."""
+    from simple_tip_tpu.ops.surprise import DSA
+
+    rng = np.random.default_rng(77)
+    f, n_train, n_test = 8, 200, 30
+    train = [rng.normal(size=(n_train, f))]
+    train_pred = rng.integers(0, 3, size=n_train)
+    test = [rng.normal(size=(n_test, f))]
+    test_pred = rng.integers(0, 3, size=n_test)
+    kw = dict(badge_size=10, subsampling=0.3, subsampling_seed=0)
+    ours = np.asarray(DSA(train, train_pred, **kw)(test, test_pred))
+    theirs = np.asarray(ref["surprise"].DSA(train, train_pred, **kw)(test, test_pred))
+    np.testing.assert_allclose(ours, theirs, rtol=1e-3)
+
+
+def test_multimodal_by_class_mdsa_matches_reference(ref):
+    from simple_tip_tpu.ops.surprise import MDSA, MultiModalSA
+
+    rng = np.random.default_rng(88)
+    f, n_train, n_test, n_classes = 6, 300, 60, 4
+    train = [rng.normal(size=(n_train, f))]
+    train_pred = rng.integers(0, n_classes, size=n_train)
+    test = [rng.normal(size=(n_test, f)) * 2]
+    test_pred = rng.integers(0, n_classes, size=n_test)
+
+    # the (ats, preds) -> SA constructor shape used by the reference's
+    # TESTED_SA registry (reference: src/dnn_test_prio/handler_surprise.py:28)
+    ours = np.asarray(
+        MultiModalSA.build_by_class(train, train_pred, lambda a, p: MDSA(a))(
+            test, test_pred
+        ),
+        np.float64,
+    )
+    ref_mdsa = ref["surprise"].MDSA
+    theirs = np.asarray(
+        ref["surprise"].MultiModalSA.build_by_class(
+            train, train_pred, lambda a, p: ref_mdsa(a)
+        )(test, test_pred),
+        np.float64,
+    )
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3)
+
+
+def test_surprise_coverage_mapper_matches_reference(ref):
+    from simple_tip_tpu.ops.surprise import SurpriseCoverageMapper
+
+    rng = np.random.default_rng(9)
+    values = rng.uniform(0, 10, size=200)
+    for sections, upper, overflow in [(10, 10.0, False), (1000, 7.5, True)]:
+        ours = SurpriseCoverageMapper(sections, upper, overflow).get_coverage_profile(
+            values
+        )
+        theirs = ref["surprise"].SurpriseCoverageMapper(
+            sections, upper, overflow
+        ).get_coverage_profile(values)
+        np.testing.assert_array_equal(np.asarray(ours), np.asarray(theirs))
+
+
+def test_mlsa_agrees_with_reference_on_separated_blobs(ref):
+    """MLSA is GMM-based (stochastic init on the reference side), so exact
+    parity is not defined; on well-separated blobs both fits converge to the
+    same mixture and the scores must be near-identical."""
+    from simple_tip_tpu.ops.surprise import MLSA
+
+    rng = np.random.default_rng(10)
+    blob_a = rng.normal(size=(100, 4)) * 0.3 + 10.0
+    blob_b = rng.normal(size=(100, 4)) * 0.3 - 10.0
+    train = [np.vstack([blob_a, blob_b])]
+    test = [rng.normal(size=(40, 4)) * 0.3 + np.where(rng.random((40, 1)) < 0.5, 10, -10)]
+    np.random.seed(0)  # the reference GMM draws from the numpy global RNG
+    ours = np.asarray(MLSA(train, num_components=2)(test), np.float64)
+    theirs = np.asarray(ref["surprise"].MLSA(train, num_components=2)(test), np.float64)
+    from scipy.stats import spearmanr
+
+    rho = spearmanr(ours, theirs).statistic
+    assert rho > 0.99, f"MLSA rank agreement too low: {rho}"
+    np.testing.assert_allclose(ours, theirs, rtol=0.05)
