@@ -49,21 +49,29 @@ class TokenAndPositionEmbedding(nn.Module):
         return tok + pos
 
 
-class RingSelfAttention(nn.Module):
-    """Self-attention whose core runs as sequence-parallel ring attention.
+class SequenceParallelSelfAttention(nn.Module):
+    """Self-attention whose core runs sequence-parallel over a device mesh.
 
-    Long-context path: Q/K/V projections are local; the attention core is the
-    exact streaming-softmax ring over the ``seq_axis`` of ``ring_mesh``
-    (parallel/ring_attention.py), so sequences can exceed one device's memory.
-    With ``ring_mesh=None`` the same parameters run through the dense oracle
+    Long-context path: Q/K/V projections are local; the attention core shards
+    the sequence axis over ``seq_axis`` of ``sp_mesh`` using one of two exact
+    strategies:
+
+    - ``impl="ring"``: streaming-softmax ring — K/V blocks rotate via
+      ppermute (parallel/ring_attention.py); no head-count constraint.
+    - ``impl="ulysses"``: all-to-all head-scatter/seq-gather, dense local
+      softmax, inverse all-to-all (parallel/ulysses_attention.py); requires
+      ``num_heads %% mesh size == 0``.
+
+    With ``sp_mesh=None`` the same parameters run through the dense oracle
     core — enabling single-device use and equivalence testing.
     """
 
     num_heads: int
     qkv_features: int
     out_features: int
-    ring_mesh: Optional[Mesh] = None
+    sp_mesh: Optional[Mesh] = None
     seq_axis: str = "sp"
+    impl: str = "ring"
 
     @nn.compact
     def __call__(self, x):
@@ -79,18 +87,35 @@ class RingSelfAttention(nn.Module):
         q = proj(name="query")(x)
         k = proj(name="key")(x)
         v = proj(name="value")(x)
-        if self.ring_mesh is not None:
-            from simple_tip_tpu.parallel.ring_attention import check_ring_divisibility
+        if self.impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown impl {self.impl!r}; use 'ring' or 'ulysses'"
+            )
+        if self.sp_mesh is not None:
+            n_dev = self.sp_mesh.shape[self.seq_axis]
+            if self.impl == "ulysses":
+                from simple_tip_tpu.parallel.ulysses_attention import (
+                    check_ulysses_divisibility,
+                    ulysses_attention,
+                )
 
-            check_ring_divisibility(x.shape[1], self.ring_mesh.shape[self.seq_axis])
+                check_ulysses_divisibility(x.shape[1], self.num_heads, n_dev)
+                shard_fn = functools.partial(
+                    ulysses_attention, axis_name=self.seq_axis
+                )
+            else:
+                from simple_tip_tpu.parallel.ring_attention import (
+                    check_ring_divisibility,
+                )
+
+                check_ring_divisibility(x.shape[1], n_dev)
+                shard_fn = functools.partial(
+                    ring_attention, axis_name=self.seq_axis, n_dev=n_dev
+                )
             spec = P(None, self.seq_axis, None, None)
             core = jax.shard_map(
-                functools.partial(
-                    ring_attention,
-                    axis_name=self.seq_axis,
-                    n_dev=self.ring_mesh.shape[self.seq_axis],
-                ),
-                mesh=self.ring_mesh,
+                shard_fn,
+                mesh=self.sp_mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
             )
@@ -105,8 +130,9 @@ class RingSelfAttention(nn.Module):
 class TransformerBlock(nn.Module):
     """Post-LN transformer encoder block, Keras-tutorial style.
 
-    ``attention_impl``: "dense" (default, Keras-parity MHA) or "ring"
-    (sequence-parallel ring attention over ``ring_mesh``).
+    ``attention_impl``: "dense" (default, Keras-parity MHA), "ring"
+    (sequence-parallel streaming-softmax ring over ``sp_mesh``), or
+    "ulysses" (sequence-parallel all-to-all head scatter over ``sp_mesh``).
     """
 
     embed_dim: int
@@ -114,24 +140,26 @@ class TransformerBlock(nn.Module):
     ff_dim: int
     rate: float = 0.1
     attention_impl: str = "dense"
-    ring_mesh: Optional[Mesh] = None
+    sp_mesh: Optional[Mesh] = None
     seq_axis: str = "sp"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         # Keras MultiHeadAttention(key_dim=embed_dim) uses *per-head* dim
         # embed_dim => total qkv features = num_heads * embed_dim.
-        if self.attention_impl not in ("dense", "ring"):
+        if self.attention_impl not in ("dense", "ring", "ulysses"):
             raise ValueError(
-                f"unknown attention_impl {self.attention_impl!r}; use 'dense' or 'ring'"
+                f"unknown attention_impl {self.attention_impl!r}; "
+                "use 'dense', 'ring' or 'ulysses'"
             )
-        if self.attention_impl == "ring":
-            attn = RingSelfAttention(
+        if self.attention_impl in ("ring", "ulysses"):
+            attn = SequenceParallelSelfAttention(
                 num_heads=self.num_heads,
                 qkv_features=self.num_heads * self.embed_dim,
                 out_features=self.embed_dim,
-                ring_mesh=self.ring_mesh,
+                sp_mesh=self.sp_mesh,
                 seq_axis=self.seq_axis,
+                impl=self.attention_impl,
             )(x)
         else:
             attn = nn.MultiHeadDotProductAttention(
@@ -152,9 +180,10 @@ class TransformerBlock(nn.Module):
 class ImdbTransformer(nn.Module):
     """2-class IMDB sentiment classifier with Keras-index taps.
 
-    ``attention_impl="ring"`` (+ ``ring_mesh``) switches the encoder block to
-    sequence-parallel ring attention for long-context scaling; the default
-    "dense" path is the reference-parity architecture.
+    ``attention_impl="ring"`` or ``"ulysses"`` (+ ``sp_mesh``) switches the
+    encoder block to sequence-parallel attention for long-context scaling
+    (ppermute ring vs all-to-all head scatter); the default "dense" path is
+    the reference-parity architecture.
     """
 
     vocab_size: int = 2000
@@ -164,7 +193,7 @@ class ImdbTransformer(nn.Module):
     ff_dim: int = 32
     num_classes: int = 2
     attention_impl: str = "dense"
-    ring_mesh: Optional[Mesh] = None
+    sp_mesh: Optional[Mesh] = None
     seq_axis: str = "sp"
 
     has_dropout = True
@@ -183,7 +212,7 @@ class ImdbTransformer(nn.Module):
             self.num_heads,
             self.ff_dim,
             attention_impl=self.attention_impl,
-            ring_mesh=self.ring_mesh,
+            sp_mesh=self.sp_mesh,
             seq_axis=self.seq_axis,
         )(h, train)
         taps[2] = h

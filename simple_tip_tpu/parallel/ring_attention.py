@@ -47,11 +47,16 @@ def ring_attention(q, k, v, axis_name: str, n_dev: int):
     scale = np.float32(1.0 / np.sqrt(q.shape[-1]))
     b, t_q, h, dh = q.shape
 
-    # pvary: mark the fresh accumulators as device-varying over the ring axis
-    # so the scan carry types line up (shard_map vma semantics).
-    o = jax.lax.pvary(jnp.zeros((b, h, t_q, dh), q.dtype), axis_name)
-    m = jax.lax.pvary(jnp.full((b, h, t_q), -jnp.inf, q.dtype), axis_name)
-    l = jax.lax.pvary(jnp.zeros((b, h, t_q), q.dtype), axis_name)
+    # mark the fresh accumulators as device-varying over the ring axis so the
+    # scan carry types line up (shard_map vma semantics).
+    def _varying(x):
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(x, axis_name, to="varying")
+        return jax.lax.pvary(x, axis_name)
+
+    o = _varying(jnp.zeros((b, h, t_q, dh), q.dtype))
+    m = _varying(jnp.full((b, h, t_q), -jnp.inf, q.dtype))
+    l = _varying(jnp.zeros((b, h, t_q), q.dtype))
     perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
 
     def step(i, carry):
@@ -88,24 +93,35 @@ def check_ring_divisibility(seq_len: int, n_dev: int) -> None:
         )
 
 
-def ring_attention_sharded(
-    q: np.ndarray, k: np.ndarray, v: np.ndarray, mesh: Mesh, axis: str = "sp"
-):
-    """Run ring attention with the sequence axis of q/k/v sharded over
-    ``axis`` of ``mesh``. Host-convenience wrapper around shard_map."""
-    check_ring_divisibility(q.shape[1], mesh.shape[axis])
+def sharded_attention(q, k, v, mesh: Mesh, axis: str, kernel_fn):
+    """Shared scaffolding for the sequence-parallel attention wrappers:
+    shard q/k/v over ``axis`` of ``mesh`` and run ``kernel_fn`` (a per-shard
+    collective taking (q, k, v)) under shard_map + jit."""
     spec = P(None, axis, None, None)
     fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name=axis, n_dev=mesh.shape[axis]),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+        kernel_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
     sharding = NamedSharding(mesh, spec)
     q = jax.device_put(jnp.asarray(q), sharding)
     k = jax.device_put(jnp.asarray(k), sharding)
     v = jax.device_put(jnp.asarray(v), sharding)
     return jax.jit(fn)(q, k, v)
+
+
+def ring_attention_sharded(
+    q: np.ndarray, k: np.ndarray, v: np.ndarray, mesh: Mesh, axis: str = "sp"
+):
+    """Run ring attention with the sequence axis of q/k/v sharded over
+    ``axis`` of ``mesh``. Host-convenience wrapper around shard_map."""
+    check_ring_divisibility(q.shape[1], mesh.shape[axis])
+    return sharded_attention(
+        q,
+        k,
+        v,
+        mesh,
+        axis,
+        functools.partial(ring_attention, axis_name=axis, n_dev=mesh.shape[axis]),
+    )
 
 
 def sequence_parallel_mesh(n_devices: Optional[int] = None) -> Mesh:

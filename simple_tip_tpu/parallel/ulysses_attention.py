@@ -1,0 +1,90 @@
+"""Ulysses-style all-to-all sequence/context-parallel attention.
+
+The complementary long-context strategy to ring attention
+(parallel/ring_attention.py): instead of rotating K/V blocks around a ring,
+two ``all_to_all`` collectives re-shard the tensors between a
+*sequence-sharded* layout and a *head-sharded* layout (DeepSpeed-Ulysses
+pattern):
+
+1. inputs arrive sharded over the sequence axis — each device holds
+   ``[batch, seq/n, heads, head_dim]``;
+2. an all-to-all scatters the head axis and gathers the sequence axis, so
+   each device holds the FULL sequence for ``heads/n`` heads;
+3. plain dense attention runs locally per head group (heads are independent
+   in multi-head attention, so this is exact, not an approximation);
+4. the inverse all-to-all restores the sequence-sharded layout.
+
+Trade-off vs the ring: Ulysses does 2 all-to-alls of the whole Q/K/V/O
+tensors (cheap on a TPU torus where all-to-all rides ICI) and then needs NO
+communication inside the softmax, while the ring does ``n`` neighbor
+ppermutes of K/V interleaved with compute. Ulysses requires
+``num_heads % n == 0``; the ring has no head constraint but serializes the
+softmax over ``n`` steps. Both are exact; which is faster depends on
+seq_len/heads/mesh — this framework ships both behind one model switch
+(models/transformer.py ``attention_impl``).
+
+The reference has no long-context machinery at all (max seq len 100,
+SURVEY.md section 5); this subsystem is TPU-native new capability.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+
+def check_ulysses_divisibility(seq_len: int, num_heads: int, n_dev: int) -> None:
+    """Reject shapes the head-scatter / seq-gather cannot split evenly.
+
+    Like the ring's divisibility guard, failing loudly here avoids silent
+    shard padding that would corrupt the softmax normalizer."""
+    if seq_len % n_dev != 0:
+        raise ValueError(
+            f"ulysses attention requires the sequence length ({seq_len}) to be "
+            f"divisible by the sequence-parallel mesh size ({n_dev})"
+        )
+    if num_heads % n_dev != 0:
+        raise ValueError(
+            f"ulysses attention requires the head count ({num_heads}) to be "
+            f"divisible by the sequence-parallel mesh size ({n_dev}); use ring "
+            f"attention (no head constraint) for this mesh"
+        )
+
+
+def ulysses_attention(q, k, v, axis_name: str):
+    """Exact attention with sequence-sharded inputs via two all-to-alls.
+
+    Shapes (per device): q/k/v = [batch, seq_local, heads, head_dim].
+    Returns [batch, seq_local, heads, head_dim] (same sharded layout).
+    Must run inside shard_map/pmap with ``axis_name`` bound.
+    """
+    # seq-sharded -> head-sharded: split heads (axis 2), gather seq (axis 1).
+    a2a = functools.partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+    q_h, k_h, v_h = a2a(q), a2a(k), a2a(v)  # [b, seq_full, heads/n, dh]
+
+    scale = np.float32(1.0 / np.sqrt(q.shape[-1]))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q_h, k_h) * scale
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights, v_h)
+
+    # head-sharded -> seq-sharded: split seq (axis 1), gather heads (axis 2).
+    return jax.lax.all_to_all(
+        out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention_sharded(
+    q: np.ndarray, k: np.ndarray, v: np.ndarray, mesh: Mesh, axis: str = "sp"
+):
+    """Run ulysses attention with the sequence axis of q/k/v sharded over
+    ``axis`` of ``mesh``. Host-convenience wrapper around shard_map."""
+    from simple_tip_tpu.parallel.ring_attention import sharded_attention
+
+    check_ulysses_divisibility(q.shape[1], q.shape[2], mesh.shape[axis])
+    return sharded_attention(
+        q, k, v, mesh, axis, functools.partial(ulysses_attention, axis_name=axis)
+    )

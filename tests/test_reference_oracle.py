@@ -57,7 +57,10 @@ def ref():
         import src.core.surprise as ref_surprise
     finally:
         sys.path.remove(str(REFERENCE_DIR))
-    if isinstance(getattr(ref_kde.StableGaussianKDE, "inv_cov", None), property):
+    shadowed_inv_cov = isinstance(
+        getattr(ref_kde.StableGaussianKDE, "inv_cov", None), property
+    )
+    if shadowed_inv_cov:
         ref_kde.StableGaussianKDE.inv_cov = None
     # Modern scipy's evaluate() consumes `cho_cov`, which scipy 1.7's
     # _compute_covariance contract (what the reference implements) never set.
@@ -82,6 +85,11 @@ def ref():
         del np.int
     if not had_bool:
         del np.bool
+    # restore the oracle class: the reference module stays cached in
+    # sys.modules, so later importers must see the unpatched original
+    ref_kde.StableGaussianKDE._compute_covariance = _ref_compute
+    if shadowed_inv_cov:
+        del ref_kde.StableGaussianKDE.inv_cov
 
 
 # ---------------------------------------------------------------------------

@@ -39,7 +39,7 @@ def test_imdb_transformer_ring_attention_matches_dense_core():
 
     mesh = sequence_parallel_mesh(4)
     model_ref = ImdbTransformer(maxlen=64, attention_impl="ring")  # dense core
-    model_ring = ImdbTransformer(maxlen=64, attention_impl="ring", ring_mesh=mesh)
+    model_ring = ImdbTransformer(maxlen=64, attention_impl="ring", sp_mesh=mesh)
 
     rng = np.random.default_rng(0)
     x = rng.integers(0, 2000, size=(4, 64)).astype(np.int32)
@@ -66,7 +66,7 @@ def test_ring_attention_rejects_uneven_sequence():
     with pytest.raises(ValueError, match="divisible"):
         ring_attention_sharded(q, k, v, mesh)
 
-    model = ImdbTransformer(maxlen=100, attention_impl="ring", ring_mesh=mesh)
+    model = ImdbTransformer(maxlen=100, attention_impl="ring", sp_mesh=mesh)
     x = np.zeros((2, 100), np.int32)
     with pytest.raises(ValueError, match="divisible"):
         init_params(model, jax.random.PRNGKey(0), x[:1])
