@@ -53,17 +53,21 @@ class SequenceParallelSelfAttention(nn.Module):
     """Self-attention whose core runs sequence-parallel over a device mesh.
 
     Long-context path: Q/K/V projections are local; the attention core shards
-    the sequence axis over ``seq_axis`` of ``sp_mesh`` using one of two exact
-    strategies:
+    the sequence axis over ``seq_axis`` of ``sp_mesh`` using one of these
+    exact strategies:
 
     - ``impl="ring"``: streaming-softmax ring — K/V blocks rotate via
       ppermute (parallel/ring_attention.py); no head-count constraint.
     - ``impl="ulysses"``: all-to-all head-scatter/seq-gather, dense local
       softmax, inverse all-to-all (parallel/ulysses_attention.py); requires
       ``num_heads %% mesh size == 0``.
+    - ``impl="flash"``: single-device Pallas flash kernel (``sp_mesh`` must
+      be None) — the score matrix streams through VMEM instead of
+      materializing in HBM (ops/flash_attention.py).
 
-    With ``sp_mesh=None`` the same parameters run through the dense oracle
-    core — enabling single-device use and equivalence testing.
+    With ``sp_mesh=None`` (and impl != "flash") the same parameters run
+    through the dense oracle core — enabling single-device use and
+    equivalence testing.
     """
 
     num_heads: int
@@ -87,9 +91,15 @@ class SequenceParallelSelfAttention(nn.Module):
         q = proj(name="query")(x)
         k = proj(name="key")(x)
         v = proj(name="value")(x)
-        if self.impl not in ("ring", "ulysses"):
+        if self.impl not in ("ring", "ulysses", "flash"):
             raise ValueError(
-                f"unknown impl {self.impl!r}; use 'ring' or 'ulysses'"
+                f"unknown impl {self.impl!r}; use 'ring', 'ulysses' or 'flash'"
+            )
+        if self.impl == "flash" and self.sp_mesh is not None:
+            raise ValueError(
+                "impl='flash' is the single-device core; combine long "
+                "sequences with a mesh via impl='ring' or 'ulysses' "
+                "(ulysses uses the flash kernel as its local core on TPU)"
             )
         if self.sp_mesh is not None:
             n_dev = self.sp_mesh.shape[self.seq_axis]
@@ -120,6 +130,13 @@ class SequenceParallelSelfAttention(nn.Module):
                 out_specs=spec,
             )
             out = core(q, k, v)
+        elif self.impl == "flash":
+            from simple_tip_tpu.ops.flash_attention import (
+                flash_attention,
+                flash_available,
+            )
+
+            out = flash_attention(q, k, v, interpret=not flash_available())
         else:
             out = ring_self_attention_reference(q, k, v)
         return nn.DenseGeneral(
@@ -131,8 +148,9 @@ class TransformerBlock(nn.Module):
     """Post-LN transformer encoder block, Keras-tutorial style.
 
     ``attention_impl``: "dense" (default, Keras-parity MHA), "ring"
-    (sequence-parallel streaming-softmax ring over ``sp_mesh``), or
-    "ulysses" (sequence-parallel all-to-all head scatter over ``sp_mesh``).
+    (sequence-parallel streaming-softmax ring over ``sp_mesh``), "ulysses"
+    (sequence-parallel all-to-all head scatter over ``sp_mesh``), or "flash"
+    (single-device Pallas VMEM-tiled kernel).
     """
 
     embed_dim: int
@@ -147,12 +165,12 @@ class TransformerBlock(nn.Module):
     def __call__(self, x, train: bool = False):
         # Keras MultiHeadAttention(key_dim=embed_dim) uses *per-head* dim
         # embed_dim => total qkv features = num_heads * embed_dim.
-        if self.attention_impl not in ("dense", "ring", "ulysses"):
+        if self.attention_impl not in ("dense", "ring", "ulysses", "flash"):
             raise ValueError(
                 f"unknown attention_impl {self.attention_impl!r}; "
-                "use 'dense', 'ring' or 'ulysses'"
+                "use 'dense', 'ring', 'ulysses' or 'flash'"
             )
-        if self.attention_impl in ("ring", "ulysses"):
+        if self.attention_impl in ("ring", "ulysses", "flash"):
             attn = SequenceParallelSelfAttention(
                 num_heads=self.num_heads,
                 qkv_features=self.num_heads * self.embed_dim,

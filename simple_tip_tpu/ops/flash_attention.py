@@ -1,0 +1,187 @@
+"""Pallas TPU flash-attention kernel: the per-chip attention core.
+
+Completes the long-context stack (SURVEY.md section 5 notes the reference has
+none): across chips the sequence axis shards via ring or ulysses collectives
+(parallel/ring_attention.py, parallel/ulysses_attention.py); within a chip
+this kernel computes exact attention without ever materializing the
+[seq_q, seq_kv] score matrix in HBM. K/V tiles stream through VMEM while
+flash-style running (max, normalizer, output) accumulators live in VMEM
+scratch; each tile contributes one MXU matmul for scores and one for the
+weighted values.
+
+Layout matches the other attention cores: q/k/v = [batch, seq, heads,
+head_dim]. Sequence lengths are padded to the block size internally; padded
+KEY positions are masked to -inf before the streaming softmax (padded query
+rows compute garbage that is sliced off on return — they cannot contaminate
+real rows).
+
+The kernel runs on the TPU backend or anywhere under ``interpret=True``
+(how the CPU test suite pins it against the dense oracle).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # pallas import is deferred-failure: CPU-only setups keep working
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAVE_PALLAS = False
+
+# Per-tile row counts are adaptive: up to MAX_BLOCK (measured best on TPU v5e
+# at long sequences: 43 TFLOP/s f32 at seq 32k vs 6.5 at block 128; 2048
+# exceeds VMEM), rounded down to the actual padded sequence for short inputs
+# so the 100-token parity models don't pay padded-row compute.
+MAX_BLOCK = 1024
+LANE = 128  # TPU lane granularity; block sizes are multiples of this
+
+NEG_INF = -1e30  # large-finite: -inf breaks the m=-inf first-tile correction
+
+
+def _block_for(t: int) -> int:
+    """Tile size for a sequence of length ``t``: the smallest lane-multiple
+    block that covers the lane-padded length in the minimum number of
+    MAX_BLOCK-bounded tiles (avoids near-doubling the padding for lengths
+    just above a block multiple, e.g. t=1100 -> block 640 x 2 tiles = 1280
+    rows rather than 1024 x 2 = 2048)."""
+    padded = -(-t // LANE) * LANE
+    n_tiles = -(-padded // MAX_BLOCK)
+    per_tile = -(-padded // n_tiles)
+    return -(-per_tile // LANE) * LANE
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale, kv_len, n_kv
+):
+    """One grid step: fold kv tile j into the streaming-softmax state."""
+    j = pl.program_id(2)
+
+    q = q_ref[0]  # [bq, dh]
+    k = k_ref[0]  # [bk, dh]
+    v = v_ref[0]  # [bk, dh]
+    s = (
+        jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # [bq, bk]
+    # mask padded key positions
+    col = j * k.shape[0] + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(col < kv_len, s, NEG_INF)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    m_prev = m_ref[:, 0]  # [bq]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    alpha = jnp.exp(m_prev - m_new)  # [bq]
+    p = jnp.exp(s - m_new[:, None])  # [bq, bk]
+    l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+    acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+        p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = m_new[:, None]
+    l_ref[:] = l_new[:, None]
+
+    @pl.when(j == n_kv - 1)
+    def _():
+        o_ref[0] = acc_ref[:] / l_ref[:]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kv_len", "block_q", "block_kv", "interpret")
+)
+def _flash_call(q, k, v, kv_len: int, block_q: int, block_kv: int, interpret=False):
+    """q [G, Tq, dh] x k/v [G, Tkv, dh] -> [G, Tq, dh]; T* are block multiples."""
+    g, t_q, dh = q.shape
+    t_kv = k.shape[1]
+    n_q, n_kv = t_q // block_q, t_kv // block_kv
+    scale = np.float32(1.0 / np.sqrt(dh))
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, kv_len=kv_len, n_kv=n_kv
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(g, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_kv, dh), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_kv, dh), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, dh), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
+        ),
+        # vma: inside shard_map (e.g. as ulysses' local core) the output must
+        # declare which mesh axes it varies over — inherit the query's.
+        out_shape=jax.ShapeDtypeStruct(
+            (g, t_q, dh), jnp.float32, vma=getattr(jax.typeof(q), "vma", None)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running normalizer
+            pltpu.VMEM((block_q, dh), jnp.float32),  # running output
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_attention(q, k, v, interpret: bool = False):
+    """Exact attention, [batch, seq, heads, head_dim] in and out.
+
+    Same contract as ``ring_self_attention_reference`` (the dense oracle);
+    score matrix is tiled through VMEM instead of materialized.
+    """
+    if not HAVE_PALLAS:
+        raise RuntimeError(
+            "jax.experimental.pallas is unavailable in this jax build; use "
+            "the dense or ring attention cores instead"
+        )
+    b, t_q, h, dh = q.shape
+    t_kv = k.shape[1]
+    block_q, block_kv = _block_for(t_q), _block_for(t_kv)
+
+    def pad_to_block(x, block):
+        t = x.shape[1]
+        pad = (-t) % block
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        return x
+
+    q_p = pad_to_block(q, block_q)
+    k_p = pad_to_block(k, block_kv)
+    v_p = pad_to_block(v, block_kv)
+    # [b, T, h, dh] -> [b*h, T, dh]
+    fold = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(
+        b * h, x.shape[1], dh
+    )
+    out = _flash_call(
+        fold(q_p).astype(jnp.float32),
+        fold(k_p).astype(jnp.float32),
+        fold(v_p).astype(jnp.float32),
+        kv_len=t_kv,
+        block_q=block_q,
+        block_kv=block_kv,
+        interpret=interpret,
+    )
+    out = out.reshape(b, h, -1, dh).transpose(0, 2, 1, 3)[:, :t_q]
+    return out.astype(q.dtype)
+
+
+def flash_available() -> bool:
+    """Whether the compiled (non-interpret) flash path applies here."""
+    if not HAVE_PALLAS:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False

@@ -23,6 +23,11 @@ softmax over ``n`` steps. Both are exact; which is faster depends on
 seq_len/heads/mesh — this framework ships both behind one model switch
 (models/transformer.py ``attention_impl``).
 
+Because each device sees the FULL gathered sequence after the all-to-all,
+the local core defaults to the Pallas flash kernel on TPU — a dense local
+softmax would materialize the [T, T] score matrix in HBM and OOM at exactly
+the lengths ulysses exists for (SCALING.md: dense dies at seq 8k on v5e).
+
 The reference has no long-context machinery at all (max seq len 100,
 SURVEY.md section 5); this subsystem is TPU-native new capability.
 """
@@ -53,12 +58,21 @@ def check_ulysses_divisibility(seq_len: int, num_heads: int, n_dev: int) -> None
         )
 
 
-def ulysses_attention(q, k, v, axis_name: str):
+def ulysses_attention(
+    q, k, v, axis_name: str, local_core: str = "auto", interpret: bool = False
+):
     """Exact attention with sequence-sharded inputs via two all-to-alls.
 
     Shapes (per device): q/k/v = [batch, seq_local, heads, head_dim].
     Returns [batch, seq_local, heads, head_dim] (same sharded layout).
     Must run inside shard_map/pmap with ``axis_name`` bound.
+
+    ``local_core`` selects the per-device attention over the gathered (full)
+    sequence: "flash" tiles it through VMEM with the Pallas kernel
+    (ops/flash_attention.py) so the [T, T] score matrix never hits HBM —
+    essential at the long-context lengths ulysses exists for; "dense"
+    materializes it (fine for short sequences and the CPU test mesh);
+    "auto" picks flash on the TPU backend, dense elsewhere.
     """
     # seq-sharded -> head-sharded: split heads (axis 2), gather seq (axis 1).
     a2a = functools.partial(
@@ -66,10 +80,24 @@ def ulysses_attention(q, k, v, axis_name: str):
     )
     q_h, k_h, v_h = a2a(q), a2a(k), a2a(v)  # [b, seq_full, heads/n, dh]
 
-    scale = np.float32(1.0 / np.sqrt(q.shape[-1]))
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q_h, k_h) * scale
-    weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", weights, v_h)
+    if local_core == "auto":
+        from simple_tip_tpu.ops.flash_attention import flash_available
+
+        local_core = "flash" if flash_available() else "dense"
+    if local_core == "flash":
+        from simple_tip_tpu.ops.flash_attention import flash_attention
+
+        # [b, seq_full, heads/n, dh]; interpret=True is the CPU test path
+        out = flash_attention(q_h, k_h, v_h, interpret=interpret)
+    elif local_core == "dense":
+        scale = np.float32(1.0 / np.sqrt(q.shape[-1]))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q_h, k_h) * scale
+        weights = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v_h)
+    else:
+        raise ValueError(
+            f"unknown local_core {local_core!r}; use 'auto', 'flash' or 'dense'"
+        )
 
     # head-sharded -> seq-sharded: split seq (axis 1), gather heads (axis 2).
     return jax.lax.all_to_all(

@@ -49,6 +49,49 @@ def test_ulysses_matches_ring():
     np.testing.assert_allclose(out_uly, out_ring, rtol=2e-4, atol=2e-5)
 
 
+def test_ulysses_flash_local_core_matches_dense():
+    """The flash local core (what TPU auto-selects, so the gathered-sequence
+    score matrix never hits HBM) must agree with the dense local core."""
+    import functools
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from simple_tip_tpu.parallel.ulysses_attention import ulysses_attention
+
+    rng = np.random.default_rng(2)
+    b, t, h, dh = 1, 64, 4, 8
+    q = rng.normal(size=(b, t, h, dh)).astype(np.float32)
+    k = rng.normal(size=(b, t, h, dh)).astype(np.float32)
+    v = rng.normal(size=(b, t, h, dh)).astype(np.float32)
+    mesh = sequence_parallel_mesh(2)
+    spec = P(None, "sp", None, None)
+
+    def run(local_core):
+        fn = jax.shard_map(
+            functools.partial(
+                ulysses_attention,
+                axis_name="sp",
+                local_core=local_core,
+                interpret=True,  # pallas interpret mode on the CPU mesh
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            # pallas's interpret-mode emulator mixes unvarying internal
+            # constants into dynamic_slice, tripping the vma checker; the
+            # compiled TPU path declares vma properly (ops/flash_attention.py)
+            check_vma=False,
+        )
+        sharding = NamedSharding(mesh, spec)
+        args = [jax.device_put(jnp.asarray(x), sharding) for x in (q, k, v)]
+        return np.asarray(jax.jit(fn)(*args))
+
+    np.testing.assert_allclose(
+        run("flash"), run("dense"), rtol=1e-5, atol=1e-6
+    )
+
+
 def test_ulysses_divisibility_guards():
     with pytest.raises(ValueError, match="sequence length"):
         check_ulysses_divisibility(seq_len=100, num_heads=8, n_dev=8)
