@@ -35,12 +35,20 @@ def expected_priority_types(has_dropout: bool) -> List[str]:
     return types
 
 
+def _usable_files(folder: str) -> Set[str]:
+    """Artifact names present AND non-empty: a crash can cut a write short,
+    and a zero-byte .npy/.pickle would pass a pure name-membership audit only
+    to fail at aggregation time."""
+    if not os.path.isdir(folder):
+        return set()
+    return {e.name for e in os.scandir(folder) if e.stat().st_size > 0}
+
+
 def check_prio_artifacts(
     case_study: str, runs: range, has_dropout: bool = True
 ) -> Dict[int, Set[str]]:
-    """Missing prio artifacts per run id (empty dict = complete)."""
-    prio = os.path.join(output_folder(), "priorities")
-    existing = set(os.listdir(prio)) if os.path.isdir(prio) else set()
+    """Missing or truncated prio artifacts per run id (empty dict = complete)."""
+    existing = _usable_files(os.path.join(output_folder(), "priorities"))
     missing: Dict[int, Set[str]] = {}
     for run in runs:
         for ds in ["nominal", "ood"]:
@@ -60,8 +68,7 @@ def check_al_artifacts(
     evaluation (reference: src/dnn_test_prio/eval_active_learning.py:97-147);
     the VR selection exists only for models with dropout layers.
     """
-    al = os.path.join(output_folder(), "active_learning")
-    existing = set(os.listdir(al)) if os.path.isdir(al) else set()
+    existing = _usable_files(os.path.join(output_folder(), "active_learning"))
     approaches = [a for a in APPROACHES if has_dropout or a != "VR"]
     expected_names = ["original_na"] + [
         f"{approach}_{oodnom}"
@@ -81,9 +88,8 @@ def check_al_artifacts(
 
 
 def check_model_checkpoints(case_study: str, runs: range) -> List[int]:
-    """Run ids without a persisted model checkpoint."""
-    folder = os.path.join(output_folder(), "models", case_study)
-    existing = set(os.listdir(folder)) if os.path.isdir(folder) else set()
+    """Run ids without a usable (present, non-empty) model checkpoint."""
+    existing = _usable_files(os.path.join(output_folder(), "models", case_study))
     return [r for r in runs if f"{r}.msgpack" not in existing]
 
 

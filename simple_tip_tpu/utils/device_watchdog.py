@@ -3,49 +3,85 @@
 The TPU in this deployment is reached through a tunnel that can wedge: device
 programs then hang indefinitely rather than erroring (observed: a killed
 client left the device stream stuck; every later jax op blocked forever).
-``ensure_responsive_backend`` probes the default backend with a trivial op
-under a timeout and, when the probe hangs or fails, switches the process to
-the CPU backend so benchmarks and smoke tests degrade loudly instead of
-hanging a pipeline forever.
+``ensure_responsive_backend`` probes the default backend and, when the probe
+hangs or fails, switches the process to the CPU backend so benchmarks and
+smoke tests degrade loudly instead of hanging a pipeline forever.
+
+The probe runs in a SUBPROCESS, not a thread: backend initialization inside
+jax is serialized behind a process-wide lock, so an in-process probe that
+wedges during init leaves the lock held and the CPU fallback then blocks on
+the same lock (observed during a live tunnel outage — the previous
+thread-based probe turned the watchdog itself into a hang). A stuck
+subprocess is simply killed.
+
+Call this BEFORE the first jax device use in the process (bench.py and the
+driver entry do), otherwise the broken backend may already be wedging the
+in-process init lock.
 """
 
 import logging
-import threading
+import os
+import subprocess
+import sys
 
 logger = logging.getLogger(__name__)
+
+_PROBE = (
+    "import jax, jax.numpy as jnp; "
+    "jax.jit(lambda x: x + 1)(jnp.ones(8)).block_until_ready(); "
+    "print(jax.devices()[0].platform)"
+)
 
 
 def ensure_responsive_backend(timeout_s: float = 90.0) -> str:
     """Return the platform that will be used ('tpu', 'cpu', ...).
 
-    Probes the default jax backend with a tiny jitted op in a daemon thread;
-    if it does not complete within ``timeout_s``, reconfigures jax for the CPU
-    backend (the stuck probe thread is abandoned — it holds no locks the CPU
-    backend needs).
+    Probes the default jax backend with a tiny jitted op in a subprocess;
+    if that does not complete within ``timeout_s``, reconfigures this
+    process for the CPU backend. Every failure mode of the probe itself
+    (spawn failure, crash, hang, kill-resistant D-state child) degrades to
+    the CPU fallback — this function must never hang or raise.
     """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # CPU is already forced (tests, explicit fallback): nothing to probe,
+        # and skipping avoids paying a jax import in a discarded subprocess.
+        return "cpu"
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=os.environ.copy(),
+        )
+        try:
+            out, err = proc.communicate(timeout=timeout_s)
+            if proc.returncode == 0 and out.strip():
+                return out.strip().splitlines()[-1]
+            logger.error(
+                "device probe exited %s (stderr tail: %s) — falling back to CPU",
+                proc.returncode,
+                err.strip()[-300:],
+            )
+        except subprocess.TimeoutExpired:
+            logger.error(
+                "default accelerator unresponsive after %.0fs — falling back "
+                "to CPU",
+                timeout_s,
+            )
+            proc.kill()
+            try:
+                # bounded: a child wedged in an uninterruptible device ioctl
+                # can survive SIGKILL; abandon it rather than hang ourselves
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                logger.error("probe child survived SIGKILL; abandoning it")
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.error("device probe could not run (%s) — falling back to CPU", e)
+
     import jax
 
-    result = []
-
-    def probe():
-        try:
-            import jax.numpy as jnp
-
-            jax.jit(lambda x: x + 1)(jnp.ones(8)).block_until_ready()
-            result.append(jax.devices()[0].platform)
-        except Exception as e:  # pragma: no cover - depends on broken backend
-            logger.warning("device probe failed: %s", e)
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if result:
-        return result[0]
-
-    logger.error(
-        "default accelerator unresponsive after %.0fs — falling back to CPU",
-        timeout_s,
-    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
     try:
         import jax.extend.backend

@@ -119,3 +119,37 @@ def test_end_to_end_prio_and_al(tiny_assets):
     )
     assert os.path.isdir(at_dir)
     assert sorted(os.listdir(at_dir))[0] == "labels"
+
+    # --- crash recovery: a killed test_prio leaves a partial artifact bus;
+    # the audit must flag the gap and a phase re-run must restore it (the
+    # reference's restartability contract, SURVEY.md section 5: idempotent
+    # file-granular artifacts, phases overwrite on re-run) ---
+    from simple_tip_tpu.utils.artifact_check import check_prio_artifacts
+
+    victims = [
+        "tinymnist_nominal_0_uncertainty_deep_gini.npy",
+        "tinymnist_ood_0_dsa_scores.npy",
+        "tinymnist_nominal_0_NBC_0_cam_order.npy",
+    ]
+    for f in victims:
+        os.remove(os.path.join(prio, f))
+    # a zero-byte file stands in for a write cut off mid-crash
+    truncated = os.path.join(prio, "tinymnist_ood_0_pc-lsa_scores.npy")
+    open(truncated, "wb").close()
+
+    missing = check_prio_artifacts("tinymnist", [0], has_dropout=True)
+    assert missing, "audit must flag the gap left by the simulated crash"
+    flagged = missing[0]
+    for f in victims:
+        assert f in flagged
+    assert os.path.basename(truncated) in flagged, (
+        "audit must flag the zero-byte (truncated-write) artifact too"
+    )
+
+    cs.run_prio_eval([0])  # restart semantics: overwrite/complete
+    files_after = set(os.listdir(prio))
+    for f in victims:
+        assert f in files_after, f"re-run did not restore {f}"
+    assert os.path.getsize(truncated) > 0, "truncated artifact not rewritten"
+    assert not check_prio_artifacts("tinymnist", [0], has_dropout=True)
+    assert set(files) == files_after
