@@ -54,7 +54,6 @@ def cam_order(scores: np.ndarray, profiles: np.ndarray) -> np.ndarray:
     profiles = profiles.copy()
     num_coverable = profiles.sum(axis=1).astype(np.int64)
     remaining = int(profiles.shape[1])
-    yielded = np.zeros(scores.shape[0], dtype=bool)
     picked = []
     while True:
         nxt = int(np.argmax(num_coverable))
@@ -62,7 +61,6 @@ def cam_order(scores: np.ndarray, profiles: np.ndarray) -> np.ndarray:
         if newly_covered == 0:
             break
         picked.append(nxt)
-        yielded[nxt] = True
         covering_columns = profiles[nxt].nonzero()[0]
         remaining -= newly_covered
         num_coverable -= profiles[:, covering_columns].sum(axis=1)
@@ -70,13 +68,20 @@ def cam_order(scores: np.ndarray, profiles: np.ndarray) -> np.ndarray:
         if remaining == 0:
             break
 
-    # Remaining samples by descending original score; already-picked samples
-    # are pushed to the very end and cut off.
+    return _with_score_tail(scores, np.asarray(picked, dtype=np.int64))
+
+
+def _with_score_tail(scores: np.ndarray, picked: np.ndarray) -> np.ndarray:
+    """Append the non-picked samples in descending original-score order
+    (shared by the host, native and device CAM paths; the sentinel trick
+    pushes already-picked samples past a guaranteed-lower bound so one
+    argsort yields the tail)."""
+    scores = np.asarray(scores).copy()
     min_score = scores.min() - 1
-    scores[yielded] = min_score - 1
+    scores[picked] = min_score - 1
     rest = np.argsort(-scores)
-    rest = rest[~ (scores[rest] < min_score)]
-    order = np.concatenate([np.asarray(picked, dtype=np.int64), rest.astype(np.int64)])
+    rest = rest[~(scores[rest] < min_score)]
+    order = np.concatenate([picked, rest.astype(np.int64)])
     assert order.shape[0] == scores.shape[0]
     return order
 
@@ -89,3 +94,89 @@ def _native_cam(scores: np.ndarray, profiles: np.ndarray):
         return cam_native(scores, profiles)
     except (ImportError, OSError):
         return None
+
+
+def device_cam_greedy(packed_profiles, num_samples: int):
+    """Greedy CAM phase on device over bit-packed profiles.
+
+    ``packed_profiles``: [n, words] uint32, bit j of word k = section 32*k+j.
+    Returns ``(picked, count)``: an int32 [n] array whose first ``count``
+    entries are the greedy picks in order (tie-break: lowest index, matching
+    np.argmax), the rest -1.
+
+    The loop is a ``lax.while_loop`` — each step recomputes every sample's
+    marginal gain as one fused popcount/AND sweep (TPU vector units; no
+    host round-trip per pick). Useful when profiles already live on device
+    (the coverage engine computes them there): the greedy phase then runs
+    where the data is, and only the small pick list crosses to host for the
+    score tail of ``cam_order``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    p = jnp.asarray(packed_profiles, dtype=jnp.uint32)
+    n = num_samples
+
+    def cond(state):
+        _, _, count, last_gain = state
+        return jnp.logical_and(last_gain > 0, count < n)
+
+    def body(state):
+        covered, picked, count, _ = state
+        # already-picked samples need no mask: once covered includes their
+        # profile, their marginal gain is 0 forever, and a 0 max gain ends
+        # the loop anyway
+        gains = jnp.sum(
+            jax.lax.population_count(p & ~covered[None, :]), axis=1
+        ).astype(jnp.int32)
+        nxt = jnp.argmax(gains).astype(jnp.int32)  # first max = lowest index
+        gain = gains[nxt]
+        do_pick = gain > 0
+        covered = jnp.where(do_pick, covered | p[nxt], covered)
+        picked = jnp.where(
+            do_pick, picked.at[count].set(nxt), picked
+        )
+        count = jnp.where(do_pick, count + 1, count)
+        return covered, picked, count, gain
+
+    words = p.shape[1]
+    init = (
+        jnp.zeros((words,), jnp.uint32),
+        jnp.full((n,), -1, jnp.int32),
+        jnp.asarray(0, jnp.int32),
+        jnp.asarray(1, jnp.int32),  # sentinel: enter the loop
+    )
+    covered, picked, count, _ = jax.lax.while_loop(cond, body, init)
+    return picked, count
+
+
+def pack_profiles(profiles: np.ndarray):
+    """Bit-pack boolean [n, w] profiles into [n, ceil(w/32)] uint32 (bit j of
+    word k = section 32*k+j, the layout device_cam_greedy expects)."""
+    profiles = np.asarray(profiles, dtype=bool).reshape((profiles.shape[0], -1))
+    n, w = profiles.shape
+    pad = (-w) % 32
+    if pad:
+        profiles = np.concatenate(
+            [profiles, np.zeros((n, pad), dtype=bool)], axis=1
+        )
+    bits = profiles.reshape(n, -1, 32).astype(np.uint32)
+    shifts = np.arange(32, dtype=np.uint32)
+    return (bits << shifts[None, None, :]).sum(axis=2, dtype=np.uint32)
+
+
+def cam_order_device(scores: np.ndarray, profiles: np.ndarray) -> np.ndarray:
+    """CAM order with the greedy phase on device (same result as cam_order).
+
+    ``profiles`` may be boolean [n, w] (packed here) or already-packed uint32
+    [n, words] — a device-resident packed array is passed through untouched
+    (no host round-trip; only the small pick list crosses back).
+    """
+    if getattr(profiles, "dtype", None) == np.uint32:
+        packed = profiles  # np or jnp; device arrays stay on device
+    else:
+        packed = pack_profiles(np.asarray(profiles))
+    picked_dev, count_dev = device_cam_greedy(packed, packed.shape[0])
+    count = int(count_dev)
+    picked = np.asarray(picked_dev)[:count].astype(np.int64)
+    return _with_score_tail(np.asarray(scores), picked)

@@ -41,7 +41,16 @@ def _usable_files(folder: str) -> Set[str]:
     to fail at aggregation time."""
     if not os.path.isdir(folder):
         return set()
-    return {e.name for e in os.scandir(folder) if e.stat().st_size > 0}
+    usable = set()
+    for e in os.scandir(folder):
+        try:
+            if e.stat().st_size > 0:
+                usable.add(e.name)
+        except FileNotFoundError:
+            # vanished between listing and stat (a writer is replacing it
+            # mid-audit): not usable right now
+            continue
+    return usable
 
 
 def check_prio_artifacts(

@@ -81,3 +81,60 @@ def test_cam_fuzzer(seed: int, shape: Tuple[int], prob: float):
         assert new_coverage_sum - previous_coverage_sum <= last_coverage_increment
         last_coverage_increment = new_coverage_sum - previous_coverage_sum
         previous_coverage_sum = new_coverage_sum
+
+
+# ---------------------------------------------------------------------------
+# Device CAM (lax.while_loop greedy over bit-packed profiles)
+# ---------------------------------------------------------------------------
+
+
+def test_pack_profiles_layout():
+    from simple_tip_tpu.ops.prioritizers import pack_profiles
+
+    profiles = np.zeros((2, 40), dtype=bool)
+    profiles[0, 0] = True    # word 0, bit 0
+    profiles[0, 33] = True   # word 1, bit 1
+    profiles[1, 39] = True   # word 1, bit 7
+    packed = pack_profiles(profiles)
+    assert packed.shape == (2, 2)
+    assert packed[0, 0] == 1 and packed[0, 1] == 2
+    assert packed[1, 0] == 0 and packed[1, 1] == 128
+
+
+def test_device_cam_matches_host_on_random_instances():
+    from simple_tip_tpu.ops.prioritizers import cam_order, cam_order_device
+
+    rng = np.random.default_rng(0)
+    for n, w, density in [(30, 17, 0.3), (100, 64, 0.1), (200, 250, 0.05)]:
+        profiles = rng.random((n, w)) < density
+        scores = rng.integers(0, 5, size=n).astype(np.float64)  # heavy ties
+        np.testing.assert_array_equal(
+            cam_order_device(scores, profiles), cam_order(scores, profiles)
+        )
+
+
+def test_device_cam_all_zero_profiles_falls_back_to_scores():
+    from simple_tip_tpu.ops.prioritizers import cam_order, cam_order_device
+
+    rng = np.random.default_rng(1)
+    scores = rng.random(20)
+    profiles = np.zeros((20, 8), dtype=bool)
+    np.testing.assert_array_equal(
+        cam_order_device(scores, profiles), cam_order(scores, profiles)
+    )
+
+
+def test_device_cam_accepts_prepacked_profiles():
+    from simple_tip_tpu.ops.prioritizers import (
+        cam_order,
+        cam_order_device,
+        pack_profiles,
+    )
+
+    rng = np.random.default_rng(2)
+    profiles = rng.random((50, 33)) < 0.2
+    scores = rng.random(50)
+    np.testing.assert_array_equal(
+        cam_order_device(scores, pack_profiles(profiles)),
+        cam_order(scores, profiles),
+    )
