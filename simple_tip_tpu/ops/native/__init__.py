@@ -88,12 +88,9 @@ def cam_native(scores: np.ndarray, profiles: np.ndarray) -> np.ndarray:
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     picked = out[:n_picked]
-    scores = np.asarray(scores, dtype=np.float64).copy()
-    min_score = scores.min() - 1
-    scores[picked] = min_score - 1
-    rest = np.argsort(-scores)
-    rest = rest[~(scores[rest] < min_score)]
-    return np.concatenate([picked, rest.astype(np.int64)])
+    from simple_tip_tpu.ops.prioritizers import _with_score_tail
+
+    return _with_score_tail(scores, picked)
 
 
 def cam_order_packed(scores: np.ndarray, packed: np.ndarray, m_bits: int) -> np.ndarray:
@@ -111,12 +108,9 @@ def cam_order_packed(scores: np.ndarray, packed: np.ndarray, m_bits: int) -> np.
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     picked = out[:n_picked]
-    scores = np.asarray(scores, dtype=np.float64).copy()
-    min_score = scores.min() - 1
-    scores[picked] = min_score - 1
-    rest = np.argsort(-scores)
-    rest = rest[~(scores[rest] < min_score)]
-    return np.concatenate([picked, rest.astype(np.int64)])
+    from simple_tip_tpu.ops.prioritizers import _with_score_tail
+
+    return _with_score_tail(scores, picked)
 
 
 def lev_matrix(words: List[str]) -> np.ndarray:

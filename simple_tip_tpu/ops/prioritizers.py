@@ -73,14 +73,22 @@ def cam_order(scores: np.ndarray, profiles: np.ndarray) -> np.ndarray:
 
 def _with_score_tail(scores: np.ndarray, picked: np.ndarray) -> np.ndarray:
     """Append the non-picked samples in descending original-score order
-    (shared by the host, native and device CAM paths; the sentinel trick
-    pushes already-picked samples past a guaranteed-lower bound so one
-    argsort yields the tail)."""
+    (shared by the host, native and device CAM paths).
+
+    The argsort input uses the reference's sentinel trick (picked samples
+    get min-1-1) so tie ordering matches it exactly, but the picked samples
+    are then removed by an explicit index mask rather than the reference's
+    ``< min_score`` comparison: with scores containing -inf (or magnitudes
+    where ``min - 1 == min`` in float64) the sentinel is indistinguishable
+    from a real score and the reference silently yields picked samples
+    twice — the mask keeps the order well-formed on those inputs too."""
     scores = np.asarray(scores).copy()
-    min_score = scores.min() - 1
-    scores[picked] = min_score - 1
+    picked = np.asarray(picked, dtype=np.int64)
+    scores[picked] = scores.min() - 2
     rest = np.argsort(-scores)
-    rest = rest[~(scores[rest] < min_score)]
+    is_picked = np.zeros(scores.shape[0], dtype=bool)
+    is_picked[picked] = True
+    rest = rest[~is_picked[rest]]
     order = np.concatenate([picked, rest.astype(np.int64)])
     assert order.shape[0] == scores.shape[0]
     return order

@@ -23,8 +23,13 @@ def initialize(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
 ) -> None:
-    """Initialize jax.distributed (no-op when single-process or already up)."""
-    if jax.process_count() > 1:
+    """Initialize jax.distributed (no-op when single-process or already up).
+
+    Must run before anything initializes the XLA backend — so the
+    already-up check uses ``jax.distributed.is_initialized()``, NOT
+    ``jax.process_count()`` (which would itself initialize the backend and
+    make distributed startup impossible)."""
+    if jax.distributed.is_initialized():
         return
     if coordinator_address is None:
         logger.info("single-process run; jax.distributed not initialized")

@@ -138,3 +138,33 @@ def test_device_cam_accepts_prepacked_profiles():
         cam_order_device(scores, pack_profiles(profiles)),
         cam_order(scores, profiles),
     )
+
+
+def test_cam_order_handles_neg_inf_scores():
+    """-inf scores (realistic for log-likelihood-based SA values) defeat the
+    reference's sentinel filter — it silently yields picked samples twice.
+    All our CAM paths must still emit a well-formed permutation, with -inf
+    samples ordered last among the score tail."""
+    from simple_tip_tpu.ops.prioritizers import cam_order, cam_order_device
+
+    scores = np.array([0.5, -np.inf, 0.9, -np.inf, 0.1])
+    profiles = np.zeros((5, 4), dtype=bool)
+    profiles[2, :2] = True  # one sample with coverage -> greedy picks it
+    for order in (cam_order(scores, profiles), cam_order_device(scores, profiles)):
+        assert sorted(order.tolist()) == [0, 1, 2, 3, 4]
+        assert order[0] == 2  # greedy pick
+        assert order.tolist()[1:3] == [0, 4]  # finite scores descending
+        assert set(order.tolist()[3:]) == {1, 3}  # -inf last
+
+
+def test_cam_order_handles_huge_magnitude_scores():
+    """Scores where min-1 == min in float64 (>= ~1e17) also defeat the
+    reference sentinel; the mask-based tail stays a permutation."""
+    from simple_tip_tpu.ops.prioritizers import cam_order
+
+    scores = np.array([-1e18, 3e17, 2e17])
+    profiles = np.zeros((3, 2), dtype=bool)
+    profiles[0, 0] = True
+    order = cam_order(scores, profiles)
+    assert sorted(order.tolist()) == [0, 1, 2]
+    assert order.tolist() == [0, 1, 2]
