@@ -360,6 +360,88 @@ def test_surprise_coverage_mapper_matches_reference(ref):
         np.testing.assert_array_equal(np.asarray(ours), np.asarray(theirs))
 
 
+def test_text_corruptor_matches_reference(tmp_path, monkeypatch):
+    """Full corruption-pipeline parity: same dictionary extraction, start
+    bags, Levenshtein neighborhoods, md5 per-sentence seeding, severity
+    monotonicity and per-type corruption outputs as the reference.
+
+    The reference needs two environment shims to run offline under this
+    image: `polyleven` (C pip package, absent) is satisfied by our own C++
+    Levenshtein kernel — itself a parity statement — and its thesaurus
+    download is pre-seeded with the same tiny local jsonl both sides use."""
+    import json
+    import sys
+    import types
+
+    try:
+        from simple_tip_tpu.ops.native import levenshtein
+    except ImportError:
+        pytest.skip("native levenshtein unavailable to shim polyleven")
+
+    fake = types.ModuleType("polyleven")
+    fake.levenshtein = levenshtein
+    monkeypatch.setitem(sys.modules, "polyleven", fake)
+
+    sys.path.insert(0, str(REFERENCE_DIR))
+    try:
+        import src.core.text_corruptor as ref_tc
+    finally:
+        sys.path.remove(str(REFERENCE_DIR))
+
+    from simple_tip_tpu.ops.text_corruptor import TextCorruptor
+
+    words = (
+        "terrible amazing boring thrilling acting casting ending opening "
+        "director pictures classic modern script camera scenes minutes "
+        "wonderful horrible watchable forgettable masterpiece disaster"
+    ).split()
+    rng = np.random.default_rng(0)
+    base = [
+        " ".join(rng.choice(words, size=rng.integers(5, 12)))
+        for _ in range(60)
+    ]
+    thesaurus = [
+        {"word": "amazing", "synonyms": ["astonishing", "stunning"]},
+        {"word": "terrible", "synonyms": ["dreadful", "awful"]},
+        {"word": "pictures", "synonyms": ["films", "movies"]},
+    ]
+    jsonl = "\n".join(json.dumps(d) for d in thesaurus)
+    thes_path = tmp_path / "en_thesaurus.jsonl"
+    thes_path.write_text(jsonl)
+
+    dictionary_size = 120
+    ours = TextCorruptor(
+        base,
+        cache_dir=str(tmp_path / "ours_cache"),
+        dictionary_size=dictionary_size,
+        thesaurus_path=str(thes_path),
+    )
+
+    # pre-seed the reference's thesaurus cache path so load_bad_translations
+    # finds the same local jsonl instead of downloading (zero egress)
+    ref_hash = ref_tc._hash_text_to_str(base + [str(dictionary_size)])
+    ref_cache = tmp_path / "ref_cache" / ref_hash
+    ref_cache.mkdir(parents=True)
+    (ref_cache / "bad_translations.pkl").write_text(jsonl)
+    theirs = ref_tc.TextCorruptor(
+        base, cache_dir=str(tmp_path / "ref_cache"), dictionary_size=dictionary_size
+    )
+
+    # dictionary construction parity
+    assert ours.common_words == theirs.common_words
+    assert ours.start_bags == theirs.start_bags
+    np.testing.assert_array_equal(np.asarray(ours.lev_dist), np.asarray(theirs.lev_dist))
+    assert ours.thesaurus == theirs.thesaurus
+
+    texts = [
+        " ".join(rng.choice(words, size=rng.integers(6, 14))) for _ in range(20)
+    ]
+    for severity, seed in [(0.0, 0), (0.4, 0), (0.8, 0), (0.8, 13)]:
+        mine = ours.corrupt(texts, severity, seed, force_recalculate=True)
+        oracle = theirs.corrupt(texts, severity, seed, force_recalculate=True)
+        assert mine == oracle, f"corruption diverges at severity={severity} seed={seed}"
+
+
 def test_mlsa_agrees_with_reference_on_separated_blobs(ref):
     """MLSA is GMM-based (stochastic init on the reference side), so exact
     parity is not defined; on well-separated blobs both fits converge to the
