@@ -45,6 +45,12 @@ def ensure_responsive_backend(timeout_s: float = 90.0) -> str:
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         # CPU is already forced (tests, explicit fallback): nothing to probe,
         # and skipping avoids paying a jax import in a discarded subprocess.
+        # The env var alone is NOT enough on deployments whose sitecustomize
+        # pre-registers an accelerator plugin (it silently wins over the env);
+        # setting jax.config makes the CPU choice binding.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
         return "cpu"
     try:
         proc = subprocess.Popen(

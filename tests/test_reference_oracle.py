@@ -174,10 +174,16 @@ def test_nc_criteria_match_reference(ref, seed):
         (ours.NAC(0.0), ref["nc"].NAC(0.0)),
         (ours.NAC(0.75), ref["nc"].NAC(0.75)),
         (ours.KMNC(mins, maxs, 2), ref["nc"].KMNC(mins, maxs, 2)),
+        (ours.KMNC(mins, maxs, 5), ref["nc"].KMNC(mins, maxs, 5)),
+        (ours.KMNC(mins, maxs, 11), ref["nc"].KMNC(mins, maxs, 11)),
         (ours.NBC(mins, maxs, stds, 0.0), ref["nc"].NBC(mins, maxs, stds, 0.0)),
+        (ours.NBC(mins, maxs, stds, 0.5), ref["nc"].NBC(mins, maxs, stds, 0.5)),
         (ours.NBC(mins, maxs, stds, 1.0), ref["nc"].NBC(mins, maxs, stds, 1.0)),
+        (ours.SNAC(maxs, stds, 0.0), ref["nc"].SNAC(maxs, stds, 0.0)),
         (ours.SNAC(maxs, stds, 0.5), ref["nc"].SNAC(maxs, stds, 0.5)),
+        (ours.SNAC(maxs, stds, 1.0), ref["nc"].SNAC(maxs, stds, 1.0)),
         (ours.TKNC(1), ref["nc"].TKNC(1)),
+        (ours.TKNC(2), ref["nc"].TKNC(2)),
         (ours.TKNC(3), ref["nc"].TKNC(3)),
     ]
     for mine, oracle in pairs:
