@@ -68,3 +68,28 @@ def test_gmm_loglik_close_to_sklearn():
     np.testing.assert_allclose(
         ours.score_samples(pts), theirs.score_samples(pts), rtol=0.05, atol=0.5
     )
+
+
+def test_gmm_restarts_avoid_bad_local_optima():
+    """Vmapped EM restarts must keep fit quality at least at sklearn's level
+    on anisotropic overlapping clusters — the regime where a single unlucky
+    k-means init used to cost ~0.9 nats/sample (observed before restarts)."""
+    import numpy as np
+    from sklearn.mixture import GaussianMixture as SkGMM
+
+    from simple_tip_tpu.ops.cluster import GaussianMixture
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(4, 6)) * 2.0
+    x = np.vstack(
+        [
+            centers[i]
+            + rng.normal(size=(150, 6)) @ np.diag(rng.uniform(0.3, 2.0, 6)) * 0.8
+            for i in range(4)
+        ]
+    ).astype(np.float32)
+    ours = GaussianMixture(n_components=4, random_state=0).fit(x)
+    sk = SkGMM(n_components=4, random_state=0).fit(x)
+    # f32 vs f64 and different tie-breaks allow small slack, but the bad
+    # local optimum is ~0.9 nats worse — well outside this tolerance
+    assert ours.score_samples(x).mean() >= sk.score_samples(x).mean() - 0.05
