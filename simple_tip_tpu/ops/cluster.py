@@ -227,10 +227,17 @@ class GaussianMixture:
         """Fit by vmapped EM restarts from k-means-initialized
         responsibilities, keeping the best final log-likelihood."""
         x = np.asarray(x, dtype=np.float32)
-        base = 0 if self.random_state is None else self.random_state
+        # random_state=None keeps sklearn's nondeterministic semantics
+        base = (
+            int(np.random.RandomState().randint(2**31 - self.n_init))
+            if self.random_state is None
+            else self.random_state
+        )
         resps = []
         for s in range(self.n_init):
-            km = KMeans(self.n_components, n_init=10, random_state=base + s)
+            # n_init=1 per restart: best-of-10 k-means would converge every
+            # restart to the same labeling, de-diversifying the EM restarts
+            km = KMeans(self.n_components, n_init=1, random_state=base + s)
             labels = km.fit_predict(x)
             resps.append(np.eye(self.n_components, dtype=np.float32)[labels])
         x_j = jnp.asarray(x)

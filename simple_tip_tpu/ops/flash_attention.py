@@ -56,7 +56,7 @@ def _block_for(t: int) -> int:
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale, kv_len, n_kv
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *, scale, kv_len, n_kv
 ):
     """One grid step: fold kv tile j into the streaming-softmax state."""
     j = pl.program_id(2)
@@ -96,13 +96,13 @@ def _flash_kernel(
     @pl.when(j == n_kv - 1)
     def _():
         o_ref[0] = acc_ref[:] / l_ref[:]
+        # logsumexp residual for the backward pass
+        lse_ref[0] = m_ref[:, 0] + jnp.log(l_ref[:, 0])
 
 
-@functools.partial(
-    jax.jit, static_argnames=("kv_len", "block_q", "block_kv", "interpret")
-)
-def _flash_call(q, k, v, kv_len: int, block_q: int, block_kv: int, interpret=False):
-    """q [G, Tq, dh] x k/v [G, Tkv, dh] -> [G, Tq, dh]; T* are block multiples."""
+def _flash_fwd_call(q, k, v, kv_len: int, block_q: int, block_kv: int, interpret):
+    """q [G, Tq, dh] x k/v [G, Tkv, dh] -> (out [G, Tq, dh], lse [G, Tq]);
+    T* are block multiples."""
     g, t_q, dh = q.shape
     t_kv = k.shape[1]
     n_q, n_kv = t_q // block_q, t_kv // block_kv
@@ -110,6 +110,9 @@ def _flash_call(q, k, v, kv_len: int, block_q: int, block_kv: int, interpret=Fal
     kernel = functools.partial(
         _flash_kernel, scale=scale, kv_len=kv_len, n_kv=n_kv
     )
+    # vma: inside shard_map (e.g. as ulysses' local core) outputs must
+    # declare which mesh axes they vary over — inherit the query's.
+    vma = getattr(jax.typeof(q), "vma", None)
     return pl.pallas_call(
         kernel,
         grid=(g, n_q, n_kv),
@@ -118,14 +121,16 @@ def _flash_call(q, k, v, kv_len: int, block_q: int, block_kv: int, interpret=Fal
             pl.BlockSpec((1, block_kv, dh), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_kv, dh), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, dh), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
-        ),
-        # vma: inside shard_map (e.g. as ulysses' local core) the output must
-        # declare which mesh axes it varies over — inherit the query's.
-        out_shape=jax.ShapeDtypeStruct(
-            (g, t_q, dh), jnp.float32, vma=getattr(jax.typeof(q), "vma", None)
-        ),
+        out_specs=[
+            pl.BlockSpec(
+                (1, block_q, dh), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((g, t_q, dh), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((g, t_q), jnp.float32, vma=vma),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),  # running max
             pltpu.VMEM((block_q, 1), jnp.float32),  # running normalizer
@@ -135,11 +140,181 @@ def _flash_call(q, k, v, kv_len: int, block_q: int, block_kv: int, interpret=Fal
     )(q, k, v)
 
 
+def _bwd_p_ds(q, k, v, do, lse, dvec, *, scale, kv_len, kv_tile):
+    """Shared backward recompute for one (q block, kv block) pair:
+    p = exp(s_masked - lse) and ds = p * (dO v^T - D). Both backward
+    kernels derive their grads from exactly this pair."""
+    s = (
+        jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )
+    col = kv_tile * k.shape[0] + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(col < kv_len, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])  # [bq, bk]
+    dp = jax.lax.dot_general(
+        do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bq, bk]
+    return p, p * (dp - dvec[:, None])
+
+
+def _flash_bwd_dq_kernel(
+    k_ref, v_ref, q_ref, do_ref, lse_ref, dvec_ref, dq_ref, acc_ref,
+    *, scale, kv_len, n_kv
+):
+    """dq for one q block: fold kv tile j into the accumulator.
+
+    Standard flash backward: p = exp(s - lse); ds = p * (dO v^T - D);
+    dq += ds k * scale, with D = rowsum(dO * O) precomputed on host/XLA."""
+    j = pl.program_id(2)
+    _, ds = _bwd_p_ds(
+        q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0], dvec_ref[0],
+        scale=scale, kv_len=kv_len, kv_tile=j,
+    )
+    k = k_ref[0]
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] = acc_ref[:] + scale * jax.lax.dot_general(
+        ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == n_kv - 1)
+    def _():
+        dq_ref[0] = acc_ref[:]
+
+
+def _flash_bwd_dkv_kernel(
+    k_ref, v_ref, q_ref, do_ref, lse_ref, dvec_ref, dk_ref, dv_ref,
+    acc_dk_ref, acc_dv_ref, *, scale, kv_len, n_q
+):
+    """dk/dv for one kv block: fold q tile i into the accumulators."""
+    i = pl.program_id(2)
+    j = pl.program_id(1)
+    q = q_ref[0]  # [bq, dh]
+    do = do_ref[0]  # [bq, dh]
+    p, ds = _bwd_p_ds(
+        q, k_ref[0], v_ref[0], do, lse_ref[0], dvec_ref[0],
+        scale=scale, kv_len=kv_len, kv_tile=j,
+    )
+
+    @pl.when(i == 0)
+    def _():
+        acc_dk_ref[:] = jnp.zeros_like(acc_dk_ref)
+        acc_dv_ref[:] = jnp.zeros_like(acc_dv_ref)
+
+    acc_dv_ref[:] = acc_dv_ref[:] + jax.lax.dot_general(
+        p, do, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bk, dh]
+    acc_dk_ref[:] = acc_dk_ref[:] + scale * jax.lax.dot_general(
+        ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bk, dh]
+
+    @pl.when(i == n_q - 1)
+    def _():
+        dk_ref[0] = acc_dk_ref[:]
+        dv_ref[0] = acc_dv_ref[:]
+
+
+def _flash_bwd_call(q, k, v, out, lse, do, kv_len, block_q, block_kv, interpret):
+    """(dq, dk, dv) via the two backward kernels."""
+    g, t_q, dh = q.shape
+    t_kv = k.shape[1]
+    n_q, n_kv = t_q // block_q, t_kv // block_kv
+    scale = np.float32(1.0 / np.sqrt(dh))
+    dvec = jnp.sum(do * out, axis=-1)  # [g, t_q]
+    vma = getattr(jax.typeof(q), "vma", None)
+
+    q_spec = pl.BlockSpec(
+        (1, block_q, dh), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
+    )
+    kv_spec_dq = pl.BlockSpec(
+        (1, block_kv, dh), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM
+    )
+    row_spec = pl.BlockSpec(
+        (1, block_q), lambda b, i, j: (b, i), memory_space=pltpu.VMEM
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, kv_len=kv_len, n_kv=n_kv
+        ),
+        grid=(g, n_q, n_kv),
+        in_specs=[kv_spec_dq, kv_spec_dq, q_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((g, t_q, dh), jnp.float32, vma=vma),
+        scratch_shapes=[pltpu.VMEM((block_q, dh), jnp.float32)],
+        interpret=interpret,
+    )(k, v, q, do, lse, dvec)
+
+    # grid (g, kv blocks, q blocks): q innermost so dk/dv accumulate per kv
+    q_spec_kv = pl.BlockSpec(
+        (1, block_q, dh), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM
+    )
+    kv_spec_kv = pl.BlockSpec(
+        (1, block_kv, dh), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM
+    )
+    row_spec_kv = pl.BlockSpec(
+        (1, block_q), lambda b, j, i: (b, i), memory_space=pltpu.VMEM
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, kv_len=kv_len, n_q=n_q
+        ),
+        grid=(g, n_kv, n_q),
+        in_specs=[kv_spec_kv, kv_spec_kv, q_spec_kv, q_spec_kv, row_spec_kv, row_spec_kv],
+        out_specs=[kv_spec_kv, kv_spec_kv],
+        out_shape=[
+            jax.ShapeDtypeStruct((g, t_kv, dh), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((g, t_kv, dh), jnp.float32, vma=vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, dh), jnp.float32),
+            pltpu.VMEM((block_kv, dh), jnp.float32),
+        ],
+        interpret=interpret,
+    )(k, v, q, do, lse, dvec)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, kv_len, block_q, block_kv, interpret):
+    """Differentiable flash attention over folded padded [G, T, dh] arrays."""
+    out, _ = _flash_fwd_call(q, k, v, kv_len, block_q, block_kv, interpret)
+    return out
+
+
+def _flash_core_fwd(q, k, v, kv_len, block_q, block_kv, interpret):
+    out, lse = _flash_fwd_call(q, k, v, kv_len, block_q, block_kv, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(kv_len, block_q, block_kv, interpret, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd_call(
+        q, k, v, out, lse, do, kv_len, block_q, block_kv, interpret
+    )
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
 def flash_attention(q, k, v, interpret: bool = False):
     """Exact attention, [batch, seq, heads, head_dim] in and out.
 
     Same contract as ``ring_self_attention_reference`` (the dense oracle);
-    score matrix is tiled through VMEM instead of materialized.
+    score matrix is tiled through VMEM instead of materialized. Fully
+    differentiable: a custom VJP runs the standard flash backward (dq and
+    dk/dv as two more VMEM-tiled kernels over the saved logsumexp residual),
+    so models can TRAIN with this core — gradients never materialize the
+    [seq, seq] matrix either.
     """
     if not HAVE_PALLAS:
         raise RuntimeError(
@@ -164,14 +339,14 @@ def flash_attention(q, k, v, interpret: bool = False):
     fold = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(
         b * h, x.shape[1], dh
     )
-    out = _flash_call(
+    out = _flash_core(
         fold(q_p).astype(jnp.float32),
         fold(k_p).astype(jnp.float32),
         fold(v_p).astype(jnp.float32),
-        kv_len=t_kv,
-        block_q=block_q,
-        block_kv=block_kv,
-        interpret=interpret,
+        t_kv,
+        block_q,
+        block_kv,
+        interpret,
     )
     out = out.reshape(b, h, -1, dh).transpose(0, 2, 1, 3)[:, :t_q]
     return out.astype(q.dtype)
