@@ -1,0 +1,139 @@
+"""One-shot validation of the compiled Pallas kernels on a real TPU.
+
+Run whenever TPU access is healthy (the tunnel has outages — see
+SCALING.md / the verify skill):
+
+    python scripts/validate_tpu_kernels.py
+
+Checks, against CPU/host oracles with tunnel-proof timing (device-to-host
+fetches, best-of-5):
+
+1. flash attention forward at several shapes (incl. padded lengths)
+2. flash attention BACKWARD (custom-VJP kernels) vs host-f64 dense gradients
+3. DSA pallas kernel vs the XLA fallback path
+4. device CAM vs the host/native CAM, with timing
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fetch_time(fn, *args, reps=5):
+    out = fn(*args)
+    np.asarray(out[0] if isinstance(out, tuple) else out)  # warm + fetch
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(out[0] if isinstance(out, tuple) else out)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main():
+    from simple_tip_tpu.utils.device_watchdog import ensure_responsive_backend
+
+    platform = ensure_responsive_backend(timeout_s=90)
+    if platform == "cpu":
+        print("TPU unavailable (watchdog fell back to cpu); aborting")
+        return 1
+    import jax
+    import jax.numpy as jnp
+
+    print(f"platform: {platform}")
+    rng = np.random.default_rng(0)
+    failures = 0
+
+    # -- 1+2: flash forward + backward ------------------------------------
+    from simple_tip_tpu.ops.flash_attention import flash_attention
+
+    import scipy.special as sp
+
+    for (b, t, h, dh) in [(2, 128, 4, 16), (1, 100, 2, 32), (1, 1100, 4, 64)]:
+        q = rng.normal(size=(b, t, h, dh)).astype(np.float32)
+        k = rng.normal(size=(b, t, h, dh)).astype(np.float32)
+        v = rng.normal(size=(b, t, h, dh)).astype(np.float32)
+        w = rng.normal(size=(b, t, h, dh)).astype(np.float32)
+
+        out = np.asarray(flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+        # host-f64 oracle on a row slice
+        rows = min(8, t)
+        scores = np.einsum(
+            "qhd,khd->hqk", q[0, :rows].astype(np.float64), k[0].astype(np.float64)
+        ) / np.sqrt(dh)
+        ref = np.einsum(
+            "hqk,khd->qhd", sp.softmax(scores, axis=-1), v[0].astype(np.float64)
+        )
+        err = np.abs(out[0, :rows] - ref).max()
+        ok = err < 2e-2
+        failures += not ok
+        print(f"flash fwd  {(b,t,h,dh)}: max err vs host-f64 {err:.2e} {'OK' if ok else 'FAIL'}")
+
+        grads = jax.jit(
+            jax.grad(
+                lambda q, k, v: jnp.sum(flash_attention(q, k, v) * jnp.asarray(w)),
+                argnums=(0, 1, 2),
+            )
+        )(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        from simple_tip_tpu.parallel.ring_attention import (
+            ring_self_attention_reference,
+        )
+
+        g_ref = jax.grad(
+            lambda q, k, v: jnp.sum(
+                ring_self_attention_reference(q, k, v) * jnp.asarray(w)
+            ),
+            argnums=(0, 1, 2),
+        )(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        errs = [float(jnp.abs(a - b2).max()) for a, b2 in zip(grads, g_ref)]
+        ok = max(errs) < 5e-2  # dense-oracle bf16 MXU noise dominates
+        failures += not ok
+        print(f"flash bwd  {(b,t,h,dh)}: dq/dk/dv max errs {['%.2e' % e for e in errs]} {'OK' if ok else 'FAIL'}")
+
+    # -- 3: DSA pallas vs XLA path ----------------------------------------
+    from simple_tip_tpu.ops.surprise import DSA
+
+    f, n_train, n_test, n_classes = 64, 4000, 1000, 10
+    train = [rng.normal(size=(n_train, f)).astype(np.float32)]
+    train_pred = rng.integers(0, n_classes, size=n_train)
+    test = [rng.normal(size=(n_test, f)).astype(np.float32)]
+    test_pred = rng.integers(0, n_classes, size=n_test)
+    dsa_pallas = DSA(train, train_pred, badge_size=512)
+    dsa_pallas.use_pallas = True
+    dsa_xla = DSA(train, train_pred, badge_size=512)
+    dsa_xla.use_pallas = False
+    tp, sp_ = _fetch_time(lambda: dsa_pallas(test, test_pred))
+    tx, sx = _fetch_time(lambda: dsa_xla(test, test_pred))
+    err = np.abs(np.asarray(sp_) - np.asarray(sx)).max()
+    ok = err < 1e-3
+    failures += not ok
+    print(
+        f"DSA pallas vs XLA: max err {err:.2e} {'OK' if ok else 'FAIL'} | "
+        f"pallas {tp*1e3:.0f} ms, xla {tx*1e3:.0f} ms"
+    )
+
+    # -- 4: device CAM vs host --------------------------------------------
+    from simple_tip_tpu.ops.prioritizers import cam_order, cam_order_device
+
+    profiles = rng.random((5000, 2048)) < 0.05
+    scores = rng.random(5000)
+    td, od = _fetch_time(lambda: cam_order_device(scores, profiles))
+    th, oh = _fetch_time(lambda: cam_order(scores, profiles))
+    same = list(od) == list(oh)
+    failures += not same
+    print(
+        f"device CAM: orders {'identical' if same else 'DIVERGE'} | "
+        f"device {td*1e3:.0f} ms, host/native {th*1e3:.0f} ms"
+    )
+
+    print("ALL OK" if not failures else f"{failures} FAILURES")
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
