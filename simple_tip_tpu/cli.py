@@ -31,9 +31,16 @@ def _parse_runs(spec: str) -> List[int]:
     for part in spec.split(","):
         if "-" in part and not part.startswith("-"):
             lo, hi = part.split("-")
+            if int(hi) < int(lo):
+                raise SystemExit(
+                    f"--runs: inverted range {part!r} selects nothing "
+                    f"(did you mean {hi}-{lo}?)"
+                )
             runs.extend(range(int(lo), int(hi) + 1))
         else:
             runs.append(int(part))
+    if not runs:
+        raise SystemExit(f"--runs: {spec!r} selects no run ids")
     return runs
 
 

@@ -33,3 +33,17 @@ def test_check_reports_missing(tmp_path, monkeypatch):
 
     text = report("demo", num_runs=2)
     assert "1/2 runs complete" in text
+
+
+def test_cli_run_range_parsing_rejects_empty_selections():
+    """An inverted or empty --runs spec must abort loudly instead of
+    silently running zero models."""
+    import pytest
+
+    from simple_tip_tpu.cli import _parse_runs
+
+    assert _parse_runs("0-4") == [0, 1, 2, 3, 4]
+    assert _parse_runs("-1") == list(range(100))
+    assert _parse_runs("0,3,7") == [0, 3, 7]
+    with pytest.raises(SystemExit, match="inverted"):
+        _parse_runs("4-2")
