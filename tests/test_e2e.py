@@ -153,3 +153,66 @@ def test_end_to_end_prio_and_al(tiny_assets):
     assert os.path.getsize(truncated) > 0, "truncated artifact not rewritten"
     assert not check_prio_artifacts("tinymnist", [0], has_dropout=True)
     assert set(files) == files_after
+
+
+def test_end_to_end_imdb_transformer_pipeline(tiny_assets):
+    """Transformer-path e2e (the mnist-shaped test above covers convnets):
+    a tiny IMDB-like case study — token inputs, the effective reference taps
+    (3, 5), dsa badge size — through train -> test_prio -> APFD table."""
+    from simple_tip_tpu.casestudies.base import CaseStudy, CaseStudySpec
+    from simple_tip_tpu.models import ImdbTransformer
+    from simple_tip_tpu.plotters import eval_apfd_table
+
+    vocab, maxlen = 200, 16
+
+    def loader():
+        rng = np.random.default_rng(11)
+        # class-dependent token distributions so the model can learn
+        def make(n):
+            y = rng.integers(0, 2, size=n).astype(np.int64)
+            x = np.where(
+                y[:, None] == 1,
+                rng.integers(0, vocab // 2, size=(n, maxlen)),
+                rng.integers(vocab // 2, vocab, size=(n, maxlen)),
+            ).astype(np.int32)
+            flip = rng.random((n, maxlen)) < 0.3
+            x = np.where(flip, rng.integers(0, vocab, size=(n, maxlen)), x)
+            return x, y
+
+        x_tr, y_tr = make(160)
+        x_te, y_te = make(48)
+        x_ood, y_ood = make(48)
+        return (x_tr, y_tr), (x_te, y_te), (x_ood, y_ood)
+
+    spec = CaseStudySpec(
+        name="tinyimdb",
+        model_factory=lambda: ImdbTransformer(vocab_size=vocab, maxlen=maxlen),
+        loader=loader,
+        train_cfg=TrainConfig(
+            batch_size=32, epochs=2, learning_rate=5e-3, validation_split=0.1
+        ),
+        nc_activation_layers=(3, 5),  # effective reference taps
+        sa_activation_layers=(5,),
+        prediction_badge_size=48,
+        num_classes=2,
+        al_num_selected=8,
+        dsa_badge_size=16,
+    )
+    cs = CaseStudy(spec)
+    cs.train([0], use_mesh=True)
+    assert cs.has_model(0)
+
+    cs.run_prio_eval([0])
+    prio = os.path.join(os.environ["TIP_ASSETS"], "priorities")
+    files = os.listdir(prio)
+    assert "tinyimdb_nominal_0_is_misclassified.npy" in files
+    assert "tinyimdb_ood_0_uncertainty_VR.npy" in files  # transformer has dropout
+    assert "tinyimdb_nominal_0_dsa_scores.npy" in files
+    assert "tinyimdb_ood_0_KMNC_2_cam_order.npy" in files
+
+    df = eval_apfd_table.run(case_studies=["tinyimdb"])
+    for ds in ["nominal", "ood"]:
+        val = df.loc[
+            df.index.get_level_values("approach") == "deep_gini", ("tinyimdb", ds)
+        ].iloc[0]
+        assert 0.0 <= float(val) <= 1.0
