@@ -97,12 +97,16 @@ def _flash_kernel(
     def _():
         o_ref[0] = acc_ref[:] / l_ref[:]
         # logsumexp residual for the backward pass
-        lse_ref[0] = m_ref[:, 0] + jnp.log(l_ref[:, 0])
+        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(l_ref[:, 0])
 
 
 def _flash_fwd_call(q, k, v, kv_len: int, block_q: int, block_kv: int, interpret):
-    """q [G, Tq, dh] x k/v [G, Tkv, dh] -> (out [G, Tq, dh], lse [G, Tq]);
-    T* are block multiples."""
+    """q [G, Tq, dh] x k/v [G, Tkv, dh] -> (out [G, Tq, dh], lse [G, 1, Tq]);
+    T* are block multiples.
+
+    The lse residual rides a singleton middle axis so its block's last two
+    dims are (1, block_q) — legal under Mosaic's (8, 128) tiling rule, which
+    a 2-D [G, Tq] layout with per-G blocks of 1 row is not."""
     g, t_q, dh = q.shape
     t_kv = k.shape[1]
     n_q, n_kv = t_q // block_q, t_kv // block_kv
@@ -125,11 +129,13 @@ def _flash_fwd_call(q, k, v, kv_len: int, block_q: int, block_kv: int, interpret
             pl.BlockSpec(
                 (1, block_q, dh), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
             ),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, 1, block_q), lambda b, i, j: (b, 0, i), memory_space=pltpu.VMEM
+            ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((g, t_q, dh), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((g, t_q), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((g, 1, t_q), jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),  # running max
@@ -171,7 +177,7 @@ def _flash_bwd_dq_kernel(
     dq += ds k * scale, with D = rowsum(dO * O) precomputed on host/XLA."""
     j = pl.program_id(2)
     _, ds = _bwd_p_ds(
-        q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0], dvec_ref[0],
+        q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0, 0], dvec_ref[0, 0],
         scale=scale, kv_len=kv_len, kv_tile=j,
     )
     k = k_ref[0]
@@ -200,7 +206,7 @@ def _flash_bwd_dkv_kernel(
     q = q_ref[0]  # [bq, dh]
     do = do_ref[0]  # [bq, dh]
     p, ds = _bwd_p_ds(
-        q, k_ref[0], v_ref[0], do, lse_ref[0], dvec_ref[0],
+        q, k_ref[0], v_ref[0], do, lse_ref[0, 0], dvec_ref[0, 0],
         scale=scale, kv_len=kv_len, kv_tile=j,
     )
 
@@ -230,7 +236,7 @@ def _flash_bwd_call(q, k, v, out, lse, do, kv_len, block_q, block_kv, interpret)
     t_kv = k.shape[1]
     n_q, n_kv = t_q // block_q, t_kv // block_kv
     scale = np.float32(1.0 / np.sqrt(dh))
-    dvec = jnp.sum(do * out, axis=-1)  # [g, t_q]
+    dvec = jnp.sum(do * out, axis=-1)[:, None, :]  # [g, 1, t_q], like lse
     vma = getattr(jax.typeof(q), "vma", None)
 
     q_spec = pl.BlockSpec(
@@ -240,7 +246,7 @@ def _flash_bwd_call(q, k, v, out, lse, do, kv_len, block_q, block_kv, interpret)
         (1, block_kv, dh), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM
     )
     row_spec = pl.BlockSpec(
-        (1, block_q), lambda b, i, j: (b, i), memory_space=pltpu.VMEM
+        (1, 1, block_q), lambda b, i, j: (b, 0, i), memory_space=pltpu.VMEM
     )
     dq = pl.pallas_call(
         functools.partial(
@@ -262,7 +268,7 @@ def _flash_bwd_call(q, k, v, out, lse, do, kv_len, block_q, block_kv, interpret)
         (1, block_kv, dh), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM
     )
     row_spec_kv = pl.BlockSpec(
-        (1, block_q), lambda b, j, i: (b, i), memory_space=pltpu.VMEM
+        (1, 1, block_q), lambda b, j, i: (b, 0, i), memory_space=pltpu.VMEM
     )
     dk, dv = pl.pallas_call(
         functools.partial(

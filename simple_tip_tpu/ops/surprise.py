@@ -468,6 +468,45 @@ class MLSA(SA):
         return -self.gmm.score_samples(activations)
 
 
+def estimate_dsa_memory_bytes(
+    num_train: int, chunk_size: int, num_features: int
+) -> int:
+    """Estimated peak device bytes for one chunked DSA dispatch.
+
+    TPU analog of the reference's host-RAM estimator for the full DSA pass
+    (reference: src/core/surprise.py:653-703). There the concern is the
+    per-badge (badge x train) float distance matrices held across a thread
+    pool; here it is the HBM footprint of one jitted chunk: the resident
+    train matrix, the (chunk x train) squared-distance matrix plus its
+    same/other-class masked variants (counted separately — XLA usually fuses
+    the masks but we stay conservative), and the chunk's row operands.
+    """
+    f32 = 4
+    train_resident = num_train * num_features * f32
+    chunk_matrices = 3 * chunk_size * num_train * f32
+    chunk_rows = 2 * chunk_size * num_features * f32
+    return train_resident + chunk_matrices + chunk_rows
+
+
+def _available_accelerator_bytes() -> Optional[int]:
+    """Free bytes on the default device (HBM via ``memory_stats``), or host
+    RAM via psutil on backends without stats. ``None`` if neither works."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+        if "bytes_limit" in stats:
+            return int(stats["bytes_limit"]) - int(stats.get("bytes_in_use", 0))
+    except Exception:  # noqa: BLE001 - any backend failure degrades to psutil
+        pass
+    try:
+        import psutil
+
+        return int(psutil.virtual_memory().available)
+    except Exception:  # noqa: BLE001
+        return None
+
+
 class DSA(SA):
     """Distance-based surprise adequacy.
 
@@ -532,6 +571,33 @@ class DSA(SA):
 
         self._device_state = (train, labels, train_sq, dsa_chunk)
 
+    def _fit_chunk_to_memory(self, chunk: int, num_features: int) -> int:
+        """Shrink the device chunk until its estimated footprint fits free
+        device memory, warning like the reference's OOM predictor
+        (src/core/surprise.py:694-703) when even the minimum chunk may not."""
+        available = _available_accelerator_bytes()
+        if available is None:
+            return chunk
+        budget = int(available * 0.8)
+        n_train = self.train_activations.shape[0]
+        floor = max(1, min(chunk, self.badge_size))
+        while (
+            chunk > floor
+            and estimate_dsa_memory_bytes(n_train, chunk, num_features) > budget
+        ):
+            chunk = max(floor, chunk // 2)
+        if estimate_dsa_memory_bytes(n_train, chunk, num_features) > budget:
+            warnings.warn(
+                "DSA will likely run out of device memory: one chunk of "
+                f"{chunk} test ATs against {n_train} train ATs needs about "
+                f"{estimate_dsa_memory_bytes(n_train, chunk, num_features) / 2**30:.2f} "
+                f"GiB but only {budget / 2**30:.2f} GiB fit the memory budget "
+                "(80% of free). Consider "
+                "a smaller badge_size or stronger train subsampling.",
+                UserWarning,
+            )
+        return chunk
+
     def __call__(
         self,
         activations: Activations,
@@ -567,6 +633,7 @@ class DSA(SA):
         # Device chunk: at least badge_size, at most a few thousand rows so the
         # (chunk x train) distance matrix stays comfortably in HBM.
         chunk = int(min(max(self.badge_size, 256), 4096, max(1, n_test)))
+        chunk = self._fit_chunk_to_memory(chunk, target_ats.shape[1])
         n_chunks = math.ceil(n_test / chunk)
         padded = n_chunks * chunk
         if padded != n_test:
