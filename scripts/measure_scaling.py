@@ -83,14 +83,30 @@ def main():
         if cs not in specs:
             parser.error(f"unknown case study {cs!r}; choose from {sorted(specs)}")
         model, (x, y), batch, epochs = specs[cs]
+        # Stage the dataset on device once, outside the timed region — the
+        # pipeline holds data device-resident across epochs, so the one-time
+        # host->device transfer (minutes over the tunnel, microseconds on a
+        # real TPU host's PCIe) must not pollute the per-epoch number.
+        t0 = time.perf_counter()
+        x = jax.device_put(x)
+        y = jax.device_put(y)
+        np.asarray(x[0, 0])
+        print(f"{cs:8s} dataset staged to device in {time.perf_counter() - t0:.2f}s")
+
+        # Drain by a real device->host fetch — over the tunnel transport
+        # block_until_ready can return before the device work finishes
+        # (see SCALING.md).
+        def fetch(res):
+            return np.asarray(jax.tree_util.tree_leaves(res)[0]).ravel()[0]
+
         best = None
         for g in groups:
             cfg = TrainConfig(batch_size=batch, epochs=1, validation_split=0.1)
             # compile + drain the device queue before timing
-            jax.block_until_ready(train_ensemble(model, x, y, cfg, seeds=list(range(g))))
+            fetch(train_ensemble(model, x, y, cfg, seeds=list(range(g))))
             t0 = time.perf_counter()
             out = train_ensemble(model, x, y, cfg, seeds=list(range(g)))
-            jax.block_until_ready(out)
+            fetch(out)
             dt = time.perf_counter() - t0
             per_model = dt / g
             best = min(best, per_model) if best is not None else per_model
