@@ -59,12 +59,19 @@ def main():
         softmax_entropy,
     )
 
-    model = MnistConvNet()
+    # bfloat16 compute is the TPU-native scoring configuration (MXU-native;
+    # parameters/softmax/taps stay f32). Prediction parity with f32 is
+    # enforced by tests/test_model.py::test_bf16_compute_matches_f32.
+    # TIP_BENCH_DTYPE=float32 benches the exact-parity path instead.
+    dtype = os.environ.get("TIP_BENCH_DTYPE", "bfloat16")
+    model = MnistConvNet(compute_dtype=None if dtype == "float32" else dtype)
     params = init_params(
-        model, jax.random.PRNGKey(0), np.zeros((1, 28, 28, 1), np.float32)
+        MnistConvNet(), jax.random.PRNGKey(0), np.zeros((1, 28, 28, 1), np.float32)
     )
 
-    batch = 4096
+    # Batch 32k saturates the chip (measured: 4k -> 785k/s, 16k -> 1.45M/s,
+    # 32k -> 2.87M/s, 64k -> 2.97M/s); stay at the knee, not the plateau.
+    batch = 32768
     x = jnp.asarray(
         np.random.default_rng(0).normal(size=(batch, 28, 28, 1)).astype(np.float32)
     )
@@ -80,8 +87,8 @@ def main():
         order = jnp.argsort(-gini)
         return pred, gini, ms, p, se, order
 
-    # Warmup/compile
-    jax.block_until_ready(tip_score(params, x))
+    # Warmup/compile, drained by a real fetch (see the timed-region note)
+    np.asarray(tip_score(params, x)[1])
 
     # Measure: repeated timed rounds, report the best steady-state rate.
     # The timed region ends with an actual device->host fetch of one output:
@@ -106,6 +113,8 @@ def main():
                 "value": round(best_rate, 1),
                 "unit": "inputs/sec",
                 "vs_baseline": round(best_rate / REFERENCE_ESTIMATE_INPUTS_PER_SEC, 3),
+                "compute_dtype": dtype,
+                "batch": batch,
             }
         )
     )

@@ -14,9 +14,14 @@ activations, initialization family):
 
 Tap indices follow the Keras ``model.layers`` numbering so the reference's
 ``SA_ACTIVATION_LAYERS``/``NC_ACTIVATION_LAYERS`` configs carry over verbatim.
+
+``compute_dtype=jnp.bfloat16`` runs the conv/dense compute on the MXU's
+native bfloat16 (parameters, softmax and emitted taps stay float32 — taps
+feed host metric kernels and the softmax feeds uncertainty quantifiers, so
+both keep full precision). Default ``None`` is exact float32 parity.
 """
 
-from typing import Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax.numpy as jnp
 from flax import linen as nn
@@ -30,6 +35,7 @@ class MnistConvNet(nn.Module):
 
     num_classes: int = 10
     dropout_rate: float = 0.5
+    compute_dtype: Optional[Any] = None
 
     has_dropout = True
     # Keras layer indices usable as NC/SA taps.
@@ -39,21 +45,25 @@ class MnistConvNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[int, jnp.ndarray]]:
+        dt = self.compute_dtype
+        f32 = jnp.float32
         taps: Dict[int, jnp.ndarray] = {}
-        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", kernel_init=glorot)(x))
-        taps[0] = x
+        if dt is not None:
+            x = x.astype(dt)
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", kernel_init=glorot, dtype=dt)(x))
+        taps[0] = x.astype(f32)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        taps[1] = x
-        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", kernel_init=glorot)(x))
-        taps[2] = x
+        taps[1] = x.astype(f32)
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", kernel_init=glorot, dtype=dt)(x))
+        taps[2] = x.astype(f32)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        taps[3] = x
+        taps[3] = x.astype(f32)
         x = x.reshape((x.shape[0], -1))
-        taps[4] = x
+        taps[4] = x.astype(f32)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        taps[5] = x
-        logits = nn.Dense(self.num_classes, kernel_init=glorot)(x)
-        probs = nn.softmax(logits)
+        taps[5] = x.astype(f32)
+        logits = nn.Dense(self.num_classes, kernel_init=glorot, dtype=dt)(x)
+        probs = nn.softmax(logits.astype(f32))
         taps[6] = probs
         return probs, taps
 
@@ -62,6 +72,7 @@ class Cifar10ConvNet(nn.Module):
     """3-conv CNN for CIFAR-10; no stochastic layers (VR intentionally absent)."""
 
     num_classes: int = 10
+    compute_dtype: Optional[Any] = None
 
     has_dropout = False
     sa_layers = (3,)
@@ -70,22 +81,26 @@ class Cifar10ConvNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[int, jnp.ndarray]]:
+        dt = self.compute_dtype
+        f32 = jnp.float32
         taps: Dict[int, jnp.ndarray] = {}
-        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", kernel_init=glorot)(x))
-        taps[0] = x
+        if dt is not None:
+            x = x.astype(dt)
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", kernel_init=glorot, dtype=dt)(x))
+        taps[0] = x.astype(f32)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        taps[1] = x
-        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", kernel_init=glorot)(x))
-        taps[2] = x
+        taps[1] = x.astype(f32)
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", kernel_init=glorot, dtype=dt)(x))
+        taps[2] = x.astype(f32)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        taps[3] = x
-        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", kernel_init=glorot)(x))
-        taps[4] = x
+        taps[3] = x.astype(f32)
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", kernel_init=glorot, dtype=dt)(x))
+        taps[4] = x.astype(f32)
         x = x.reshape((x.shape[0], -1))
-        taps[5] = x
-        x = nn.relu(nn.Dense(64, kernel_init=glorot)(x))
-        taps[6] = x
-        logits = nn.Dense(self.num_classes, kernel_init=glorot)(x)
-        probs = nn.softmax(logits)
+        taps[5] = x.astype(f32)
+        x = nn.relu(nn.Dense(64, kernel_init=glorot, dtype=dt)(x))
+        taps[6] = x.astype(f32)
+        logits = nn.Dense(self.num_classes, kernel_init=glorot, dtype=dt)(x)
+        probs = nn.softmax(logits.astype(f32))
         taps[7] = probs
         return probs, taps

@@ -15,7 +15,7 @@ embedding/FFN sublayers which its own membership test silently ignores
 """
 
 import functools
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,16 +36,18 @@ class TokenAndPositionEmbedding(nn.Module):
     maxlen: int
     vocab_size: int
     embed_dim: int
+    compute_dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x):
         positions = jnp.arange(x.shape[-1])
-        tok = nn.Embed(self.vocab_size, self.embed_dim, embedding_init=_keras_uniform)(
-            x.astype(jnp.int32)
-        )
-        pos = nn.Embed(self.maxlen, self.embed_dim, embedding_init=_keras_uniform)(
-            positions
-        )
+        dt = self.compute_dtype
+        tok = nn.Embed(
+            self.vocab_size, self.embed_dim, embedding_init=_keras_uniform, dtype=dt
+        )(x.astype(jnp.int32))
+        pos = nn.Embed(
+            self.maxlen, self.embed_dim, embedding_init=_keras_uniform, dtype=dt
+        )(positions)
         return tok + pos
 
 
@@ -76,6 +78,7 @@ class SequenceParallelSelfAttention(nn.Module):
     sp_mesh: Optional[Mesh] = None
     seq_axis: str = "sp"
     impl: str = "ring"
+    compute_dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x):
@@ -86,7 +89,10 @@ class SequenceParallelSelfAttention(nn.Module):
 
         head_dim = self.qkv_features // self.num_heads
         proj = functools.partial(
-            nn.DenseGeneral, features=(self.num_heads, head_dim), kernel_init=glorot
+            nn.DenseGeneral,
+            features=(self.num_heads, head_dim),
+            kernel_init=glorot,
+            dtype=self.compute_dtype,
         )
         q = proj(name="query")(x)
         k = proj(name="key")(x)
@@ -140,7 +146,11 @@ class SequenceParallelSelfAttention(nn.Module):
         else:
             out = ring_self_attention_reference(q, k, v)
         return nn.DenseGeneral(
-            features=self.out_features, axis=(-2, -1), kernel_init=glorot, name="out"
+            features=self.out_features,
+            axis=(-2, -1),
+            kernel_init=glorot,
+            name="out",
+            dtype=self.compute_dtype,
         )(out)
 
 
@@ -160,9 +170,11 @@ class TransformerBlock(nn.Module):
     attention_impl: str = "dense"
     sp_mesh: Optional[Mesh] = None
     seq_axis: str = "sp"
+    compute_dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        dt = self.compute_dtype
         # Keras MultiHeadAttention(key_dim=embed_dim) uses *per-head* dim
         # embed_dim => total qkv features = num_heads * embed_dim.
         if self.attention_impl not in ("dense", "ring", "ulysses", "flash"):
@@ -178,6 +190,7 @@ class TransformerBlock(nn.Module):
                 sp_mesh=self.sp_mesh,
                 seq_axis=self.seq_axis,
                 impl=self.attention_impl,
+                compute_dtype=dt,
             )(x)
         else:
             attn = nn.MultiHeadDotProductAttention(
@@ -185,14 +198,15 @@ class TransformerBlock(nn.Module):
                 qkv_features=self.num_heads * self.embed_dim,
                 out_features=self.embed_dim,
                 kernel_init=glorot,
+                dtype=dt,
             )(x, x)
         attn = nn.Dropout(self.rate, deterministic=not train)(attn)
-        out1 = nn.LayerNorm(epsilon=1e-6)(x + attn)
-        ffn = nn.Dense(self.ff_dim, kernel_init=glorot)(out1)
+        out1 = nn.LayerNorm(epsilon=1e-6, dtype=dt)(x + attn)
+        ffn = nn.Dense(self.ff_dim, kernel_init=glorot, dtype=dt)(out1)
         ffn = nn.relu(ffn)
-        ffn = nn.Dense(self.embed_dim, kernel_init=glorot)(ffn)
+        ffn = nn.Dense(self.embed_dim, kernel_init=glorot, dtype=dt)(ffn)
         ffn = nn.Dropout(self.rate, deterministic=not train)(ffn)
-        return nn.LayerNorm(epsilon=1e-6)(out1 + ffn)
+        return nn.LayerNorm(epsilon=1e-6, dtype=dt)(out1 + ffn)
 
 
 class ImdbTransformer(nn.Module):
@@ -213,6 +227,7 @@ class ImdbTransformer(nn.Module):
     attention_impl: str = "dense"
     sp_mesh: Optional[Mesh] = None
     seq_axis: str = "sp"
+    compute_dtype: Optional[Any] = None
 
     has_dropout = True
     sa_layers = (5,)
@@ -222,9 +237,13 @@ class ImdbTransformer(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False) -> Tuple[jnp.ndarray, Dict[int, jnp.ndarray]]:
+        dt = self.compute_dtype
+        f32 = jnp.float32
         taps: Dict[int, jnp.ndarray] = {}
-        h = TokenAndPositionEmbedding(self.maxlen, self.vocab_size, self.embed_dim)(x)
-        taps[1] = h
+        h = TokenAndPositionEmbedding(
+            self.maxlen, self.vocab_size, self.embed_dim, compute_dtype=dt
+        )(x)
+        taps[1] = h.astype(f32)
         h = TransformerBlock(
             self.embed_dim,
             self.num_heads,
@@ -232,17 +251,18 @@ class ImdbTransformer(nn.Module):
             attention_impl=self.attention_impl,
             sp_mesh=self.sp_mesh,
             seq_axis=self.seq_axis,
+            compute_dtype=dt,
         )(h, train)
-        taps[2] = h
+        taps[2] = h.astype(f32)
         h = jnp.mean(h, axis=1)  # GlobalAveragePooling1D
-        taps[3] = h
+        taps[3] = h.astype(f32)
         h = nn.Dropout(0.1, deterministic=not train)(h)
-        taps[4] = h
-        h = nn.relu(nn.Dense(20, kernel_init=glorot)(h))
-        taps[5] = h
+        taps[4] = h.astype(f32)
+        h = nn.relu(nn.Dense(20, kernel_init=glorot, dtype=dt)(h))
+        taps[5] = h.astype(f32)
         h = nn.Dropout(0.1, deterministic=not train)(h)
-        taps[6] = h
-        logits = nn.Dense(self.num_classes, kernel_init=glorot)(h)
-        probs = nn.softmax(logits)
+        taps[6] = h.astype(f32)
+        logits = nn.Dense(self.num_classes, kernel_init=glorot, dtype=dt)(h)
+        probs = nn.softmax(logits.astype(f32))
         taps[7] = probs
         return probs, taps

@@ -97,3 +97,35 @@ def test_other_models_forward(model_cls, shape):
         assert i in taps
     for i in model.sa_layers:
         assert i in taps
+
+
+@pytest.mark.parametrize(
+    "model_pair, shape",
+    [
+        ((MnistConvNet(), MnistConvNet(compute_dtype="bfloat16")), (16, 28, 28, 1)),
+        ((Cifar10ConvNet(), Cifar10ConvNet(compute_dtype="bfloat16")), (16, 32, 32, 3)),
+        ((ImdbTransformer(), ImdbTransformer(compute_dtype="bfloat16")), (16, 100)),
+    ],
+)
+def test_bf16_compute_matches_f32(model_pair, shape):
+    """compute_dtype=bfloat16 shares the f32 parameter pytree (params stay
+    f32), predicts the same classes, keeps probs within bf16 tolerance, and
+    emits f32 taps."""
+    f32_model, bf16_model = model_pair
+    rng = np.random.default_rng(0)
+    if len(shape) == 2:
+        x = rng.integers(0, 2000, size=shape).astype(np.int32)
+    else:
+        x = rng.normal(size=shape).astype(np.float32)
+    params = init_params(f32_model, jax.random.PRNGKey(0), x[:1])
+
+    probs32, taps32 = f32_model.apply({"params": params}, x, train=False)
+    probs16, taps16 = bf16_model.apply({"params": params}, x, train=False)
+
+    assert all(np.asarray(t).dtype == np.float32 for t in taps16.values())
+    assert probs16.dtype == probs32.dtype == np.float32
+    np.testing.assert_allclose(np.asarray(probs16), np.asarray(probs32), atol=0.04)
+    agree = np.mean(
+        np.argmax(np.asarray(probs16), 1) == np.argmax(np.asarray(probs32), 1)
+    )
+    assert agree >= 0.9
