@@ -22,7 +22,7 @@ import jax
 import numpy as np
 from flax import serialization
 
-from simple_tip_tpu.config import output_folder, subdir
+from simple_tip_tpu.config import output_folder, scoring_compute_dtype, subdir
 from simple_tip_tpu.data import load_cifar10, load_fmnist, load_imdb, load_mnist
 from simple_tip_tpu.engine import activation_persistor, eval_active_learning, eval_prioritization
 from simple_tip_tpu.models import Cifar10ConvNet, ImdbTransformer, MnistConvNet
@@ -62,6 +62,12 @@ class CaseStudy:
     def __init__(self, spec: CaseStudySpec):
         self.spec = spec
         self.model_def = spec.model_factory()
+        # Scoring forward passes may run in bf16 (TIP_COMPUTE_DTYPE);
+        # training always stays f32 so checkpoints/parity are unaffected.
+        dtype = scoring_compute_dtype()
+        self.scoring_model_def = (
+            spec.model_factory(compute_dtype=dtype) if dtype else self.model_def
+        )
 
     # -- checkpointing -------------------------------------------------------
 
@@ -136,7 +142,7 @@ class CaseStudy:
             eval_prioritization.evaluate(
                 model_id=model_id,
                 case_study=self.spec.name,
-                model_def=self.model_def,
+                model_def=self.scoring_model_def,
                 params=params,
                 training_dataset=x_train,
                 nominal_test_dataset=x_test,
@@ -199,7 +205,7 @@ class CaseStudy:
             eval_active_learning.evaluate(
                 model_id=model_id,
                 case_study=self.spec.name,
-                model_def=self.model_def,
+                model_def=self.scoring_model_def,
                 params=params,
                 train_x=x_train,
                 train_y=y_train,

@@ -102,8 +102,15 @@ def main(argv=None) -> int:
 
     # jax-using phases only (check/evaluation above stay jax-free and fast)
     from simple_tip_tpu.config import enable_compilation_cache
+    from simple_tip_tpu.utils.device_watchdog import ensure_responsive_backend
 
     enable_compilation_cache()
+    # Degrade loudly to CPU when the accelerator is wedged or its transport
+    # is down (observed: multi-hour tunnel outages hang every device op, or
+    # fail backend init mid-phase) instead of dying partway through a run.
+    platform = ensure_responsive_backend()
+    if platform == "cpu":
+        logging.getLogger(__name__).warning("running on the CPU backend")
 
     from simple_tip_tpu.casestudies import get_case_study
 

@@ -57,3 +57,22 @@ def enable_compilation_cache() -> None:
 
 
 MAX_NUM_MODELS = 100
+
+
+def scoring_compute_dtype():
+    """Compute dtype for the *scoring* forward passes (prioritization and
+    active-learning selection), from ``TIP_COMPUTE_DTYPE``.
+
+    ``bfloat16`` runs model compute MXU-native (parameters, softmax and taps
+    stay f32 — see models/convnet.py); unset or ``float32`` keeps the exact
+    f32-parity path. Training always runs f32 regardless, so checkpoints and
+    the reference's training-distribution parity are unaffected.
+    """
+    value = os.environ.get("TIP_COMPUTE_DTYPE", "").strip().lower()
+    if value in ("", "float32", "f32"):
+        return None
+    if value in ("bfloat16", "bf16"):
+        return "bfloat16"
+    raise ValueError(
+        f"TIP_COMPUTE_DTYPE={value!r} not understood; use 'float32' or 'bfloat16'"
+    )

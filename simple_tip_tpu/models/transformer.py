@@ -142,7 +142,13 @@ class SequenceParallelSelfAttention(nn.Module):
                 flash_available,
             )
 
-            out = flash_attention(q, k, v, interpret=not flash_available())
+            out = flash_attention(
+                q,
+                k,
+                v,
+                interpret=not flash_available(),
+                compute_dtype=self.compute_dtype,
+            )
         else:
             out = ring_self_attention_reference(q, k, v)
         return nn.DenseGeneral(

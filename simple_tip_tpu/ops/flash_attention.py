@@ -86,8 +86,10 @@ def _flash_kernel(
     alpha = jnp.exp(m_prev - m_new)  # [bq]
     p = jnp.exp(s - m_new[:, None])  # [bq, bk]
     l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+    # p rides in the operand dtype (bf16 when the inputs are bf16 -> both
+    # matmuls hit the MXU natively); accumulation stays f32 via preferred.
     acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-        p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+        p.astype(v.dtype), v, dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     m_ref[:] = m_new[:, None]
@@ -160,6 +162,8 @@ def _bwd_p_ds(q, k, v, do, lse, dvec, *, scale, kv_len, kv_tile):
     col = kv_tile * k.shape[0] + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     s = jnp.where(col < kv_len, s, NEG_INF)
     p = jnp.exp(s - lse[:, None])  # [bq, bk]
+    # do arrives pre-cast to the kv dtype (_flash_bwd_call), so this matmul
+    # is MXU-native under bf16 like the forward's.
     dp = jax.lax.dot_general(
         do, v, dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -187,7 +191,7 @@ def _flash_bwd_dq_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     acc_ref[:] = acc_ref[:] + scale * jax.lax.dot_general(
-        ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
+        ds.astype(k.dtype), k, dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
@@ -216,11 +220,11 @@ def _flash_bwd_dkv_kernel(
         acc_dv_ref[:] = jnp.zeros_like(acc_dv_ref)
 
     acc_dv_ref[:] = acc_dv_ref[:] + jax.lax.dot_general(
-        p, do, dimension_numbers=(((0,), (0,)), ((), ())),
+        p.astype(do.dtype), do, dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # [bk, dh]
     acc_dk_ref[:] = acc_dk_ref[:] + scale * jax.lax.dot_general(
-        ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+        ds.astype(q.dtype), q, dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # [bk, dh]
 
@@ -236,7 +240,10 @@ def _flash_bwd_call(q, k, v, out, lse, do, kv_len, block_q, block_kv, interpret)
     t_kv = k.shape[1]
     n_q, n_kv = t_q // block_q, t_kv // block_kv
     scale = np.float32(1.0 / np.sqrt(dh))
+    # D in f32 (from the f32 out), then dO in the forward's compute dtype so
+    # every backward matmul runs MXU-native when the forward did.
     dvec = jnp.sum(do * out, axis=-1)[:, None, :]  # [g, 1, t_q], like lse
+    do = do.astype(q.dtype)
     vma = getattr(jax.typeof(q), "vma", None)
 
     q_spec = pl.BlockSpec(
@@ -312,7 +319,7 @@ def _flash_core_bwd(kv_len, block_q, block_kv, interpret, res, do):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def flash_attention(q, k, v, interpret: bool = False):
+def flash_attention(q, k, v, interpret: bool = False, compute_dtype=None):
     """Exact attention, [batch, seq, heads, head_dim] in and out.
 
     Same contract as ``ring_self_attention_reference`` (the dense oracle);
@@ -321,12 +328,26 @@ def flash_attention(q, k, v, interpret: bool = False):
     dk/dv as two more VMEM-tiled kernels over the saved logsumexp residual),
     so models can TRAIN with this core — gradients never materialize the
     [seq, seq] matrix either.
+
+    ``compute_dtype=jnp.bfloat16`` feeds the kernels' matmuls bf16 operands
+    (MXU-native, ~2x matmul throughput) while the streaming-softmax state,
+    logsumexp residual and all accumulations stay f32 via
+    ``preferred_element_type``; output returns in ``q.dtype``. Default
+    ``None`` inherits the operands' dtype (bf16 in -> bf16 compute — this is
+    how ulysses' local core picks the caller's precision up; anything other
+    than bf16 computes in f32, matching the dense/ring cores' contract).
     """
     if not HAVE_PALLAS:
         raise RuntimeError(
             "jax.experimental.pallas is unavailable in this jax build; use "
             "the dense or ring attention cores instead"
         )
+    if compute_dtype is not None:
+        cdt = jnp.dtype(compute_dtype)
+    elif q.dtype == jnp.bfloat16:
+        cdt = jnp.dtype(jnp.bfloat16)
+    else:
+        cdt = jnp.dtype(jnp.float32)
     b, t_q, h, dh = q.shape
     t_kv = k.shape[1]
     block_q, block_kv = _block_for(t_q), _block_for(t_kv)
@@ -346,9 +367,9 @@ def flash_attention(q, k, v, interpret: bool = False):
         b * h, x.shape[1], dh
     )
     out = _flash_core(
-        fold(q_p).astype(jnp.float32),
-        fold(k_p).astype(jnp.float32),
-        fold(v_p).astype(jnp.float32),
+        fold(q_p).astype(cdt),
+        fold(k_p).astype(cdt),
+        fold(v_p).astype(cdt),
         t_kv,
         block_q,
         block_kv,

@@ -143,3 +143,56 @@ def test_imdb_transformer_trains_with_flash_attention():
     np.testing.assert_allclose(
         np.asarray(flat_f), np.asarray(flat_d), rtol=5e-3, atol=5e-5
     )
+
+
+def test_flash_bf16_compute_close_to_dense():
+    """compute_dtype=bfloat16 keeps forward and gradients within bf16
+    tolerance of the dense f32 oracle (softmax state and accumulations stay
+    f32 inside the kernels)."""
+    rng = np.random.default_rng(1)
+    b, t, h, dh = 1, 160, 2, 16
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, t, h, dh)).astype(np.float32))
+        for _ in range(3)
+    )
+
+    def loss_flash(q, k, v):
+        out = flash_attention(
+            q, k, v, interpret=True, compute_dtype=jnp.bfloat16
+        )
+        return jnp.sum(jnp.sin(out))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.sin(ring_self_attention_reference(q, k, v)))
+
+    out = flash_attention(q, k, v, interpret=True, compute_dtype=jnp.bfloat16)
+    assert out.dtype == q.dtype  # returns caller dtype
+    ref = ring_self_attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-2)
+
+    grads_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    grads_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for name, ours, oracle in zip("qkv", grads_flash, grads_dense):
+        np.testing.assert_allclose(
+            np.asarray(ours), np.asarray(oracle), atol=6e-2,
+            err_msg=f"d{name} diverges from dense oracle",
+        )
+
+
+def test_flash_inherits_bf16_operands():
+    """bf16 q/k/v with no explicit compute_dtype compute in bf16 (the path
+    ulysses' local core takes when the caller's model runs bf16) and return
+    in the caller's dtype."""
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.normal(size=(1, 64, 2, 16)).astype(np.float32))
+    out_inherit = flash_attention(
+        q.astype(jnp.bfloat16),
+        q.astype(jnp.bfloat16),
+        q.astype(jnp.bfloat16),
+        interpret=True,
+    )
+    assert out_inherit.dtype == jnp.bfloat16
+    ref = ring_self_attention_reference(q, q, q)
+    np.testing.assert_allclose(
+        np.asarray(out_inherit, dtype=np.float32), np.asarray(ref), atol=3e-2
+    )

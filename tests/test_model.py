@@ -129,3 +129,24 @@ def test_bf16_compute_matches_f32(model_pair, shape):
         np.argmax(np.asarray(probs16), 1) == np.argmax(np.asarray(probs32), 1)
     )
     assert agree >= 0.9
+
+
+def test_scoring_compute_dtype_knob(monkeypatch):
+    """TIP_COMPUTE_DTYPE selects the scoring model's compute dtype without
+    touching the training model; bad values fail loudly."""
+    from simple_tip_tpu.casestudies.base import CASE_STUDIES, CaseStudy
+    from simple_tip_tpu.config import scoring_compute_dtype
+
+    monkeypatch.delenv("TIP_COMPUTE_DTYPE", raising=False)
+    assert scoring_compute_dtype() is None
+    cs = CaseStudy(CASE_STUDIES["mnist"])
+    assert cs.scoring_model_def is cs.model_def
+
+    monkeypatch.setenv("TIP_COMPUTE_DTYPE", "bfloat16")
+    cs = CaseStudy(CASE_STUDIES["mnist"])
+    assert cs.model_def.compute_dtype is None
+    assert cs.scoring_model_def.compute_dtype == "bfloat16"
+
+    monkeypatch.setenv("TIP_COMPUTE_DTYPE", "float8")
+    with pytest.raises(ValueError, match="float8"):
+        scoring_compute_dtype()
