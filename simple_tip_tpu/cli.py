@@ -44,25 +44,43 @@ def _parse_runs(spec: str) -> List[int]:
     return runs
 
 
-def _run_eval(which: str):
+ALL_CASE_STUDIES = ("mnist", "fmnist", "cifar10", "imdb")
+
+
+def _run_eval(which: str, case_studies=ALL_CASE_STUDIES):
     if which == "test_prio":
         from simple_tip_tpu.plotters import eval_apfd_table
 
-        eval_apfd_table.run()
+        eval_apfd_table.run(case_studies=case_studies)
     elif which == "active_learning":
         from simple_tip_tpu.plotters import eval_active_learning_table
 
-        eval_active_learning_table.run()
+        eval_active_learning_table.run(case_studies=case_studies)
     elif which == "test_prio_statistics":
         from simple_tip_tpu.plotters import eval_apfd_correlation
 
-        eval_apfd_correlation.run()
+        eval_apfd_correlation.run(case_studies=case_studies)
     elif which == "active_learning_statistics":
         from simple_tip_tpu.plotters import eval_active_correlation
 
-        eval_active_correlation.run()
+        eval_active_correlation.run(case_studies=case_studies)
     else:
         raise ValueError(f"Unknown eval type: {which}")
+
+
+def dispatch_phase(cs, phase: str, runs):
+    """Run one non-evaluation phase on a CaseStudy (shared by the CLI and
+    scripts/full_study.py so the phase->method mapping lives in one place)."""
+    if phase == "training":
+        cs.train(runs)
+    elif phase == "test_prio":
+        cs.run_prio_eval(runs)
+    elif phase == "active_learning":
+        cs.run_active_learning_eval(runs)
+    elif phase == "at_collection":
+        cs.collect_activations(runs)
+    else:
+        raise ValueError(f"Unknown phase: {phase}")
 
 
 def main(argv=None) -> int:
@@ -115,14 +133,7 @@ def main(argv=None) -> int:
     from simple_tip_tpu.casestudies import get_case_study
 
     cs = get_case_study(args.case_study)
-    if args.phase == "training":
-        cs.train(runs)
-    elif args.phase == "test_prio":
-        cs.run_prio_eval(runs)
-    elif args.phase == "active_learning":
-        cs.run_active_learning_eval(runs)
-    elif args.phase == "at_collection":
-        cs.collect_activations(runs)
+    dispatch_phase(cs, args.phase, runs)
     print("Done.")
     return 0
 
