@@ -24,15 +24,30 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def _block_update(carry, k_blk, v_blk, q, scale):
-    """Fold one K/V block into the streaming-softmax accumulators."""
-    o, m, l = carry  # [B,H,Tq,Dh], [B,H,Tq], [B,H,Tq]
+    """Fold one K/V block into the streaming-softmax accumulators.
+
+    Accumulators and softmax state are f32 no matter the operand dtype:
+    bf16 q/k/v keep both matmuls MXU-native (and halve the ring's ICI
+    traffic), but a bf16 running normalizer would decay accuracy with every
+    folded block."""
+    o, m, l = carry  # [B,H,Tq,Dh], [B,H,Tq], [B,H,Tq] — all f32
     # scores: [B, H, Tq, Tkv]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) * scale
+    scores = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32
+        )
+        * scale
+    )
     m_new = jnp.maximum(m, scores.max(axis=-1))
     correction = jnp.exp(m - m_new)
     p = jnp.exp(scores - m_new[..., None])
     l_new = l * correction + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bhqd",
+        p.astype(v_blk.dtype),
+        v_blk,
+        preferred_element_type=jnp.float32,
+    )
     o_new = o * correction[..., None] + pv
     return o_new, m_new, l_new
 
@@ -54,9 +69,9 @@ def ring_attention(q, k, v, axis_name: str, n_dev: int):
             return jax.lax.pcast(x, axis_name, to="varying")
         return jax.lax.pvary(x, axis_name)
 
-    o = _varying(jnp.zeros((b, h, t_q, dh), q.dtype))
-    m = _varying(jnp.full((b, h, t_q), -jnp.inf, q.dtype))
-    l = _varying(jnp.zeros((b, h, t_q), q.dtype))
+    o = _varying(jnp.zeros((b, h, t_q, dh), jnp.float32))
+    m = _varying(jnp.full((b, h, t_q), -jnp.inf, jnp.float32))
+    l = _varying(jnp.zeros((b, h, t_q), jnp.float32))
     perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
 
     def step(i, carry):
@@ -68,16 +83,30 @@ def ring_attention(q, k, v, axis_name: str, n_dev: int):
 
     o, m, l, _, _ = jax.lax.fori_loop(0, n_dev, step, (o, m, l, k, v))
     out = o / l[..., None]
-    return jnp.einsum("bhqd->bqhd", out)
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def dense_attention_f32_softmax(q, k, v):
+    """Dense attention core, [batch, seq, heads, head_dim] in and out, with
+    the shared precision contract of all attention cores here: softmax and
+    accumulation in f32 no matter the operand dtype (bf16 operands change
+    matmul precision only), output in ``q.dtype``. Used as the single-device
+    oracle and as ulysses' dense local core."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd",
+        weights.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
 
 
 def ring_self_attention_reference(q, k, v):
     """Dense single-device attention oracle (same layout as ring_attention)."""
-    scale = 1.0 / np.sqrt(q.shape[-1])
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bhqd", weights, v)
-    return jnp.einsum("bhqd->bqhd", out)
+    return dense_attention_f32_softmax(q, k, v)
 
 
 def check_ring_divisibility(seq_len: int, n_dev: int) -> None:

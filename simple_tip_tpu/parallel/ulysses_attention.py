@@ -35,7 +35,6 @@ SURVEY.md section 5); this subsystem is TPU-native new capability.
 import functools
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
@@ -90,10 +89,11 @@ def ulysses_attention(
         # [b, seq_full, heads/n, dh]; interpret=True is the CPU test path
         out = flash_attention(q_h, k_h, v_h, interpret=interpret)
     elif local_core == "dense":
-        scale = np.float32(1.0 / np.sqrt(q.shape[-1]))
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q_h, k_h) * scale
-        weights = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v_h)
+        from simple_tip_tpu.parallel.ring_attention import (
+            dense_attention_f32_softmax,
+        )
+
+        out = dense_attention_f32_softmax(q_h, k_h, v_h)
     else:
         raise ValueError(
             f"unknown local_core {local_core!r}; use 'auto', 'flash' or 'dense'"

@@ -135,3 +135,31 @@ def test_imdb_transformer_ulysses_rejects_too_many_devices():
     x = np.zeros((2, 64), np.int32)
     with pytest.raises(ValueError, match="ring"):
         init_params(model, jax.random.PRNGKey(0), x[:1])
+
+
+def test_ulysses_bf16_operands_stay_accurate():
+    """bf16 operands through the all-to-all path keep an f32 softmax in the
+    local core (dense on CPU; the flash kernel inherits bf16 on TPU)."""
+    jnp = jax.numpy
+    rng = np.random.default_rng(4)
+    b, t, h, dh = 2, 64, 4, 16
+    q = rng.normal(size=(b, t, h, dh)).astype(np.float32)
+    k = rng.normal(size=(b, t, h, dh)).astype(np.float32)
+    v = rng.normal(size=(b, t, h, dh)).astype(np.float32)
+
+    mesh = sequence_parallel_mesh(4)
+    out_bf16 = ulysses_attention_sharded(
+        jnp.asarray(q).astype(jnp.bfloat16),
+        jnp.asarray(k).astype(jnp.bfloat16),
+        jnp.asarray(v).astype(jnp.bfloat16),
+        mesh,
+    )
+    assert out_bf16.dtype == jnp.bfloat16
+    out_f32 = np.asarray(
+        ring_self_attention_reference(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+        )
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_bf16, dtype=np.float32), out_f32, atol=3e-2
+    )
