@@ -78,6 +78,28 @@ def main() -> int:
             f"choose from {sorted(CASE_STUDIES)}"
         )
 
+    multi_host = args.coordinator is not None or (args.num_processes or 1) > 1
+    if multi_host and (
+        args.coordinator is None
+        or args.num_processes is None
+        or args.process_id is None
+    ):
+        # Partial flags would make distributed init a silent no-op: every
+        # host would then run ALL run ids and race the artifact writes.
+        parser.error(
+            "multi-host runs need all three of --coordinator, "
+            "--num-processes and --process-id"
+        )
+
+    import jax  # importing jax does not initialize the XLA backend
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # Make the CPU choice binding BEFORE anything (including
+        # jax.distributed.initialize) touches the backend: on deployments
+        # whose sitecustomize pre-registers an accelerator plugin the env
+        # var alone silently loses, and a wedged accelerator transport then
+        # hangs the whole cluster during distributed init.
+        jax.config.update("jax_platforms", "cpu")
     # Order matters: distributed init must precede the first backend use
     # (including the watchdog probe, which initializes the backend).
     distributed.initialize(
@@ -86,7 +108,14 @@ def main() -> int:
         process_id=args.process_id,
     )
     enable_compilation_cache()
-    platform = ensure_responsive_backend()
+    if multi_host:
+        # No watchdog probe on multi-host: one host silently falling back
+        # to CPU would deadlock the others at the first collective, and on
+        # real TPU hosts the probe subprocess would contend for the local
+        # chips the parent already owns. Fail loudly instead.
+        platform = jax.default_backend()
+    else:
+        platform = ensure_responsive_backend()
     if platform == "cpu":
         log.warning("running on the CPU backend")
 
@@ -94,8 +123,6 @@ def main() -> int:
 
     all_runs = _parse_runs(args.runs)
     my_runs = distributed.host_local_model_ids(all_runs)
-    import jax
-
     print(
         f"host {jax.process_index()}/{jax.process_count()}: "
         f"{len(my_runs)}/{len(all_runs)} runs, "

@@ -113,10 +113,15 @@ class CaseStudy:
         y_onehot = np.eye(self.spec.num_classes, dtype=np.float32)[
             np.asarray(y_train).astype(np.int64).flatten()
         ]
+        # Host-LOCAL mesh: on multi-host runs each host trains its own run
+        # ids (scripts/full_study.py shards them), so the vmapped ensemble
+        # must shard over local chips only — a global mesh would require
+        # identical operands on every process.
         mesh = None
-        n_dev = len(jax.devices())
+        local = jax.local_devices()
+        n_dev = len(local)
         if use_mesh and n_dev > 1:
-            mesh = ensemble_mesh(n_ensemble=n_dev, n_data=1)
+            mesh = ensemble_mesh(n_ensemble=n_dev, n_data=1, devices=local)
         chunk = group_size * max(1, n_dev if mesh is not None else 1)
         logger.info("[%s] training runs %s", self.spec.name, todo)
         for start in range(0, len(todo), chunk):

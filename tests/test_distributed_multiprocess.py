@@ -105,3 +105,75 @@ def test_two_process_cluster_trains_ensemble_shards(tmp_path):
     for mid in range(5):
         arr = np.load(tmp_path / f"model_{mid}.npy")
         assert np.all(np.isfinite(arr))
+
+
+def test_full_study_two_hosts_shard_and_barrier(tmp_path):
+    """scripts/full_study.py across two coordinated processes: run ids shard
+    per host, training writes host-local checkpoints to the shared bus, the
+    pre-evaluation barrier holds, and only process 0 aggregates."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    data_dir = tmp_path / "datasets"
+    assets = tmp_path / "assets"
+    data_dir.mkdir()
+    assets.mkdir()
+    rng = np.random.default_rng(0)
+    np.savez(
+        data_dir / "mnist.npz",
+        x_train=rng.integers(0, 256, size=(24, 16, 16), dtype=np.uint8),
+        y_train=rng.integers(0, 10, size=24).astype(np.int64),
+        x_test=rng.integers(0, 256, size=(10, 16, 16), dtype=np.uint8),
+        y_test=rng.integers(0, 10, size=10).astype(np.int64),
+    )
+
+    port = _free_port()
+    env = {
+        k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env.update(
+        TIP_DATA_DIR=str(data_dir),
+        TIP_ASSETS=str(assets),
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        JAX_PLATFORMS="cpu",
+    )
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                os.path.join(repo, "scripts", "full_study.py"),
+                "--case-studies", "mnist",
+                "--runs", "0-2",
+                "--phases", "training,evaluation",
+                "--coordinator", f"localhost:{port}",
+                "--num-processes", "2",
+                "--process-id", str(i),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+            cwd=repo,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"host {i} failed:\n{out[-3000:]}"
+
+    # both hosts report their shard of the 3 runs
+    assert "host 0/2: 2/3 runs" in outs[0]
+    assert "host 1/2: 1/3 runs" in outs[1]
+    # all three checkpoints landed on the shared bus
+    for mid in range(3):
+        assert (assets / "models" / "mnist" / f"{mid}.msgpack").exists()
+    # only process 0 aggregated (after the barrier), process 1 skipped it
+    assert "[evaluation:test_prio]" in outs[0]
+    assert "[evaluation:test_prio]" not in outs[1]
+    assert (assets / "results" / "apfds.csv").exists()
