@@ -96,6 +96,43 @@ def check_al_artifacts(
     return missing
 
 
+def expected_times_metrics(has_dropout: bool) -> List[str]:
+    """Metric keys that get a ``[setup, pred, quant, cam]`` times pickle per
+    (case study, dataset, run): 12 NC configs + 5 SA variants + the
+    uncertainty quantifiers (VR only for models with dropout). Matches the
+    reference's file-per-metric layout (src/dnn_test_prio/
+    eval_prioritization.py:46-52). Derived from the canonical APPROACHES
+    list (its non-CAM entries are exactly the timed metric keys), so new
+    metrics are picked up here automatically."""
+    return [
+        a
+        for a in APPROACHES
+        if not a.endswith("-cam") and (has_dropout or a != "VR")
+    ]
+
+
+def check_times_artifacts(
+    case_study: str, runs: range, has_dropout: bool = True
+) -> Dict[int, int]:
+    """Missing times pickles per run id (empty dict = complete).
+
+    The APFD table's runtime columns average over the first 10 runs
+    (plotters/times_collector.py), so audit at least those.
+    """
+    existing = _usable_files(os.path.join(output_folder(), "times"))
+    missing: Dict[int, int] = {}
+    for run in runs:
+        n = sum(
+            1
+            for ds in ("nominal", "ood")
+            for metric in expected_times_metrics(has_dropout)
+            if f"{case_study}_{ds}_{run}_{metric}" not in existing
+        )
+        if n:
+            missing[run] = n
+    return missing
+
+
 def check_model_checkpoints(case_study: str, runs: range) -> List[int]:
     """Run ids without a usable (present, non-empty) model checkpoint."""
     existing = _usable_files(os.path.join(output_folder(), "models", case_study))
@@ -120,5 +157,15 @@ def report(case_study: str, num_runs: int = 100, has_dropout: bool = True) -> st
         f"  active-learning artifacts: {num_runs - len(missing_al)}/{num_runs} runs complete"
     )
     for run, n in sorted(missing_al.items())[:5]:
+        lines.append(f"    run {run}: {n} pickles missing")
+    timed_runs = min(num_runs, 10)  # the APFD table times the first 10 runs
+    missing_times = check_times_artifacts(
+        case_study, range(timed_runs), has_dropout
+    )
+    lines.append(
+        f"  times pickles (first {timed_runs} runs): "
+        f"{timed_runs - len(missing_times)}/{timed_runs} runs complete"
+    )
+    for run, n in sorted(missing_times.items())[:5]:
         lines.append(f"    run {run}: {n} pickles missing")
     return "\n".join(lines)

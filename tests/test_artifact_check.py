@@ -47,3 +47,26 @@ def test_cli_run_range_parsing_rejects_empty_selections():
     assert _parse_runs("0,3,7") == [0, 3, 7]
     with pytest.raises(SystemExit, match="inverted"):
         _parse_runs("4-2")
+
+
+def test_times_artifacts_audit(tmp_path, monkeypatch):
+    """A complete run's 2 x (12 NC + 5 SA + 5 unc) times pickles pass the
+    audit; removing one flags exactly that run; no-dropout drops VR."""
+    from simple_tip_tpu.utils.artifact_check import (
+        check_times_artifacts,
+        expected_times_metrics,
+    )
+
+    assert len(expected_times_metrics(has_dropout=True)) == 22
+    assert "VR" not in expected_times_metrics(has_dropout=False)
+
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path))
+    times = tmp_path / "times"
+    times.mkdir()
+    for ds in ("nominal", "ood"):
+        for metric in expected_times_metrics(True):
+            (times / f"mnist_{ds}_0_{metric}").write_bytes(b"x")
+    assert check_times_artifacts("mnist", range(1), True) == {}
+    (times / "mnist_ood_0_dsa").unlink()
+    assert check_times_artifacts("mnist", range(1), True) == {0: 1}
+    assert check_times_artifacts("mnist", range(2), True)[1] == 44
