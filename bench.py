@@ -40,15 +40,16 @@ def main():
     import os
 
     cpu_forced = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
-    for attempt in range(3):
+    attempts = max(1, int(os.environ.get("TIP_BENCH_RETRIES", "6")))
+    for attempt in range(attempts):
         platform = ensure_responsive_backend(timeout_s=90.0)
-        if platform != "cpu" or cpu_forced or attempt == 2:
+        if platform != "cpu" or cpu_forced or attempt == attempts - 1:
             break
         os.environ.pop("JAX_PLATFORMS", None)  # undo the fallback for retry
         import jax
 
         jax.config.update("jax_platforms", None)
-        time.sleep(60)
+        time.sleep(120)
 
     from simple_tip_tpu.models import MnistConvNet
     from simple_tip_tpu.models.train import init_params

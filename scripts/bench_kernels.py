@@ -1,0 +1,195 @@
+"""Kernel-by-kernel throughput: this framework vs the reference's own code.
+
+Imports the reference's numpy/sklearn metric kernels (no TF needed) exactly
+like tests/test_reference_oracle.py does, feeds both implementations
+identical inputs at experiment-like scales, and prints a table. Run on a
+TPU-attached host, "ours" uses the device (DSA's chunked matmuls / Pallas);
+otherwise both sides run the same CPU.
+
+Scales are chosen to finish in minutes on one host core (the reference's
+DSA is the slow side); they are labeled in the output, so numbers are
+comparable but not identical to full-study scale. Both sides report
+best-of-3; ours additionally gets one untimed warmup call so XLA compile
+time (paid once per study, amortized over 100 runs x 2 datasets) stays out
+of the steady-state number.
+
+Usage: python scripts/bench_kernels.py [--skip-reference]
+"""
+
+import argparse
+import os
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REFERENCE_DIR = pathlib.Path(os.environ.get("TIP_REFERENCE_DIR", "/root/reference"))
+
+
+def _import_reference():
+    """Reference core modules, shimmed like tests/test_reference_oracle.py:
+    numpy 1.x aliases, modern scipy's read-only ``inv_cov`` property, and the
+    ``cho_cov`` attribute scipy's evaluate() consumes nowadays."""
+    if not hasattr(np, "int"):
+        np.int = int
+    if not hasattr(np, "bool"):
+        np.bool = bool
+    sys.path.insert(0, str(REFERENCE_DIR))
+    try:
+        import src.core.neuron_coverage as ref_nc
+        import src.core.stable_kde as ref_kde
+        import src.core.surprise as ref_surprise
+    finally:
+        sys.path.remove(str(REFERENCE_DIR))
+    if isinstance(getattr(ref_kde.StableGaussianKDE, "inv_cov", None), property):
+        ref_kde.StableGaussianKDE.inv_cov = None
+    _ref_compute = ref_kde.StableGaussianKDE._compute_covariance
+
+    def _compute_covariance_with_cho(self):
+        _ref_compute(self)
+        if not getattr(self, "prepare_failed", False) and hasattr(self, "covariance"):
+            self.cho_cov = np.linalg.cholesky(self.covariance).astype(np.float64)
+
+    ref_kde.StableGaussianKDE._compute_covariance = _compute_covariance_with_cho
+    return ref_nc, ref_surprise
+
+
+def _timed(fn, *args, repeats=1, **kwargs):
+    best = None
+    out = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return out, best
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--skip-reference",
+        action="store_true",
+        help="only measure this framework's kernels",
+    )
+    args = parser.parse_args()
+
+    from simple_tip_tpu.config import enable_compilation_cache
+    from simple_tip_tpu.utils.device_watchdog import ensure_responsive_backend
+
+    enable_compilation_cache()
+    platform = ensure_responsive_backend()
+    print(f"ours runs on: {platform}")
+
+    have_ref = (REFERENCE_DIR / "src" / "core").is_dir() and not args.skip_reference
+    ref_nc = ref_surprise = None
+    if have_ref:
+        ref_nc, ref_surprise = _import_reference()
+    else:
+        print("reference unavailable or skipped — measuring ours only")
+
+    rng = np.random.default_rng(0)
+    rows = []
+
+    # ---- DSA: the hot SA kernel (pairwise nearest-neighbor distances) ----
+    n_train, n_test, feat, classes = 8192, 1024, 256, 10
+    train = rng.normal(size=(n_train, feat)).astype(np.float32)
+    train_pred = rng.integers(0, classes, n_train)
+    test = rng.normal(size=(n_test, feat)).astype(np.float32)
+    test_pred = rng.integers(0, classes, n_test)
+
+    from simple_tip_tpu.ops.surprise import DSA, LSA, MDSA
+
+    ours_dsa = DSA(train, train_pred, badge_size=512)
+    _timed(ours_dsa, test, test_pred)  # warmup/compile
+    _, t_ours = _timed(ours_dsa, test, test_pred, repeats=3)
+    t_ref = None
+    if have_ref:
+        # the reference's own default badge_size=10; larger badges make its
+        # per-badge f64 distance matrices thrash this host
+        ref_dsa = ref_surprise.DSA(train, train_pred)
+        _, t_ref = _timed(ref_dsa, test, test_pred, num_threads=4, repeats=3)
+    rows.append((f"DSA ({n_train}x{feat} train, {n_test} test)", t_ours, t_ref))
+
+    # ---- MDSA: Mahalanobis under empirical covariance ----
+    ours_mdsa = MDSA(train)
+    _timed(ours_mdsa, test)
+    _, t_ours = _timed(ours_mdsa, test, repeats=3)
+    t_ref = None
+    if have_ref:
+        ref_mdsa = ref_surprise.MDSA(train)
+        _, t_ref = _timed(ref_mdsa, test, test_pred, repeats=3)
+    rows.append((f"MDSA score ({feat} features, {n_test} test)", t_ours, t_ref))
+
+    # ---- LSA: KDE density (fit + eval; float64 host math on both sides) ----
+    n_kde_train, n_kde_test, kde_feat = 4096, 2048, 128
+    kde_train = rng.normal(size=(n_kde_train, kde_feat)).astype(np.float32)
+    kde_test = rng.normal(size=(n_kde_test, kde_feat)).astype(np.float32)
+
+    _, t_ours = _timed(lambda: LSA(kde_train)(kde_test), repeats=3)
+    t_ref = None
+    if have_ref:
+        _, t_ref = _timed(lambda: ref_surprise.LSA(kde_train)(kde_test, test_pred), repeats=3)
+    rows.append(
+        (f"LSA fit+score ({n_kde_train}x{kde_feat}, {n_kde_test} test)", t_ours, t_ref)
+    )
+
+    # ---- Neuron coverage: all 12 configured metrics over 3 tapped layers ----
+    n_cov = 10000
+    layers = [
+        rng.normal(size=(n_cov, w)).astype(np.float32) for w in (1024, 2048, 512)
+    ]
+    from simple_tip_tpu.ops import coverage as ours_cov
+    from simple_tip_tpu.ops.stats import DeviceAggregateStatisticsCollector
+
+    stats = DeviceAggregateStatisticsCollector()
+    stats.track(layers)
+    mins, maxs, stds = stats.get()
+
+    def build_metrics(nc):
+        m = {
+            "NAC_0": nc.NAC(0.0),
+            "NAC_0.75": nc.NAC(0.75),
+            "TKNC_1": nc.TKNC(1),
+            "TKNC_2": nc.TKNC(2),
+            "TKNC_3": nc.TKNC(3),
+            "KMNC_2": nc.KMNC(mins, maxs, 2),
+        }
+        for s in (0, 0.5, 1):
+            m[f"NBC_{s}"] = nc.NBC(mins, maxs, stds, s)
+            m[f"SNAC_{s}"] = nc.SNAC(maxs, stds, s)
+        return m
+
+    fused, _bits = ours_cov.make_fused_profile_fn(build_metrics(ours_cov))
+
+    def run_fused():
+        out = fused(layers)
+        # materialize on host like the handler does
+        return {k: (np.asarray(s), np.asarray(p)) for k, (s, p) in out.items()}
+
+    _timed(run_fused)  # compile
+    _, t_ours = _timed(run_fused, repeats=3)
+    t_ref = None
+    if have_ref:
+        ref_metrics = build_metrics(ref_nc)
+
+        def ref_all_metrics():
+            return {k: m(layers) for k, m in ref_metrics.items()}
+
+        _, t_ref = _timed(ref_all_metrics, repeats=3)
+    rows.append((f"12 NC metrics ({n_cov} samples, 3 layers)", t_ours, t_ref))
+
+    print()
+    print(f"{'kernel':52s} {'ours':>9s} {'reference':>10s} {'speedup':>8s}")
+    for name, ours, ref_t in rows:
+        ref_s = f"{ref_t:9.2f}s" if ref_t is not None else "       n/a"
+        speed = f"{ref_t / ours:7.1f}x" if ref_t else "     n/a"
+        print(f"{name:52s} {ours:8.2f}s {ref_s} {speed}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

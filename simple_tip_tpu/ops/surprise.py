@@ -355,7 +355,9 @@ class MDSA(SA):
     ) -> np.ndarray:
         activations = _flatten_layers(activations).astype(np.float64)
         centered = activations - self.location
-        return np.einsum("ij,jk,ik->i", centered, self.precision, centered)
+        # one BLAS gemm + a row-wise dot; the 3-operand einsum form takes
+        # numpy's unoptimized path and was ~5x slower
+        return np.einsum("ij,ij->i", centered @ self.precision, centered)
 
 
 class LSA(SA):
