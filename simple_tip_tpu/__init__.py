@@ -22,3 +22,51 @@ See SURVEY.md at the repo root for the file:line mapping to the reference.
 """
 
 __version__ = "0.1.0"
+
+
+# name -> home submodule of the lazy top-level re-exports (see __getattr__)
+_LAZY_EXPORTS = {
+    # ops.surprise
+    "SA": "ops.surprise", "DSA": "ops.surprise", "LSA": "ops.surprise",
+    "MDSA": "ops.surprise", "MLSA": "ops.surprise",
+    "MultiModalSA": "ops.surprise",
+    "SurpriseCoverageMapper": "ops.surprise",
+    # ops.coverage
+    "CoverageMethod": "ops.coverage", "NAC": "ops.coverage",
+    "KMNC": "ops.coverage", "NBC": "ops.coverage",
+    "SNAC": "ops.coverage", "TKNC": "ops.coverage",
+    # prioritizers / apfd / uncertainty / misc
+    "ctm": "ops.prioritizers", "cam": "ops.prioritizers",
+    "cam_order": "ops.prioritizers",
+    "apfd_from_order": "ops.apfd", "apfd_from_orders": "ops.apfd",
+    "deep_gini": "ops.uncertainty", "max_softmax": "ops.uncertainty",
+    "pcs": "ops.uncertainty", "softmax_entropy": "ops.uncertainty",
+    "variation_ratio": "ops.uncertainty",
+    "StableGaussianKDE": "ops.kde",
+    "Timer": "ops.timer",
+    "TextCorruptor": "ops.text_corruptor",
+    "CorruptionType": "ops.text_corruptor",
+    "CorruptionWeights": "ops.text_corruptor",
+}
+
+
+def __getattr__(name):
+    """Lazy top-level re-exports of the core kernel library.
+
+    ``from simple_tip_tpu import DSA`` works like the reference's
+    ``from src.core.surprise import DSA`` (MIGRATION.md "Library API") —
+    lazily, so ``import simple_tip_tpu`` stays free of jax/scipy imports
+    for tools that only want ``__version__`` or a submodule.
+    """
+    from importlib import import_module
+
+    if name in _LAZY_EXPORTS:
+        return getattr(
+            import_module(f"simple_tip_tpu.{_LAZY_EXPORTS[name]}"), name
+        )
+    raise AttributeError(f"module 'simple_tip_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    """Make the lazy exports visible to dir()/tab-completion."""
+    return sorted(list(globals()) + list(_LAZY_EXPORTS))
