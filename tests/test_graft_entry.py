@@ -4,21 +4,14 @@ training + sequence-parallel forward paths on the virtual CPU mesh. These
 are the two surfaces the round driver exercises; a model or mesh change
 that breaks them would otherwise only surface at round end."""
 
-import os
-import sys
-
 import jax
 import numpy as np
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
 
 def _entry_module():
-    sys.path.insert(0, _REPO)
-    try:
-        import __graft_entry__
-    finally:
-        sys.path.remove(_REPO)
+    # conftest puts the repo root on sys.path for the whole session
+    import __graft_entry__
+
     return __graft_entry__
 
 
