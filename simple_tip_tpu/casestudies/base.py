@@ -161,12 +161,22 @@ class CaseStudy:
             )
 
     def run_active_learning_eval(
-        self, model_ids: List[int], ensemble_retrain: bool = True, group_size: int = 16
+        self,
+        model_ids: List[int],
+        ensemble_retrain: Optional[bool] = None,
+        group_size: int = 16,
     ) -> None:
         """Run the active-learning phase for the requested runs.
 
-        ``ensemble_retrain`` (default) trains the ~80 per-TIP retrainings of
-        each run as grouped vmapped ensembles instead of sequentially."""
+        ``ensemble_retrain`` trains the ~80 per-TIP retrainings of each run
+        as grouped vmapped ensembles instead of sequentially. Default
+        ``None`` picks by backend: vmapping stacks each member's distinct
+        weights into grouped convolutions, which accelerators run nearly for
+        free (3-5x per-model, SCALING.md) but XLA:CPU lowers ~10x slower
+        than plain convs — measured 3.2x *slower* than sequential retrains
+        on this host — so the CPU backend defaults to sequential."""
+        if ensemble_retrain is None:
+            ensemble_retrain = jax.default_backend() != "cpu"
         (x_train, y_train), (x_test, y_test), (ood_x, ood_y) = self.spec.loader()
 
         def training_process(x, y_onehot, seed):

@@ -96,7 +96,11 @@ def test_end_to_end_prio_and_al(tiny_assets):
             assert 0.0 <= float(val) <= 1.0
 
     # --- phase: active_learning ---
-    cs.run_active_learning_eval([0])
+    # Pin the batch path: the backend-aware default resolves to sequential
+    # on the CPU test host, which would leave the grouped-ensemble glue
+    # (batch_training_process + the batch branch of eval_active_learning)
+    # untested here.
+    cs.run_active_learning_eval([0], ensemble_retrain=True)
     al = os.path.join(os.environ["TIP_ASSETS"], "active_learning")
     al_files = os.listdir(al)
     assert "tinymnist_0_original_na.pickle" in al_files
