@@ -7,25 +7,57 @@ device->host transfer and the descending argsort that yields the CTM
 prioritization order. This is the per-input hot path of the reference's
 ``test_prio`` phase (SURVEY.md section 3.2).
 
+Robustness contract (round-1 postmortem): this script must print its ONE
+JSON line within a bounded wall-clock under EVERY condition, including a
+multi-hour accelerator-tunnel outage. Structure:
+
+- The PARENT process never imports jax. It launches the measurement in a
+  subprocess with a hard timeout, so a child wedged in an uninterruptible
+  device call can simply be killed (a SIGALRM in-process would never fire
+  while the GIL is held inside a stuck transport ioctl).
+- Attempt 1 runs on the default backend (the accelerator, guarded by the
+  subprocess watchdog probe). Attempt 2 forces CPU with shapes sized to
+  finish on one core, and the record is labeled ``"degraded": true``.
+- If both children fail, the parent still emits a degraded zero record.
+
 Baseline: the reference wall-clocks its TIP phase on a multi-GPU TF-2.6 box
 but publishes no per-input rate (SURVEY.md section 6). ``vs_baseline``
-therefore compares against a documented estimate of 10,000 inputs/sec for the
-reference's TF predict+quantify path on its GPU (batch-32 Keras predict with
-uwiz quantifiers) — conservative for the reference, so treat the ratio as
-indicative, not exact.
+compares against a documented ESTIMATE of 10,000 inputs/sec for the
+reference's f32 TF predict+quantify path (batch-32 Keras predict with uwiz
+quantifiers) — the JSON carries ``baseline: {estimate: true, dtype:
+"float32"}`` so the ratio is never mistaken for a measured apples-to-apples
+number (our default compute dtype is bfloat16; TIP_BENCH_DTYPE=float32
+benches the exact-parity path instead).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import numpy as np
 
 REFERENCE_ESTIMATE_INPUTS_PER_SEC = 10_000.0
 
+METRIC = "prioritizer_inputs_per_sec_per_chip"
+BASELINE_INFO = {
+    "inputs_per_sec": REFERENCE_ESTIMATE_INPUTS_PER_SEC,
+    "estimate": True,
+    "dtype": "float32",
+    "source": "documented estimate for the reference's TF GPU predict+quantify path",
+}
 
-def main():
+# Wall-clock budgets (seconds). Worst case total:
+# accelerator child (300) + cpu child (210) + overhead << any driver budget.
+ACCEL_CHILD_TIMEOUT_S = float(os.environ.get("TIP_BENCH_ACCEL_TIMEOUT_S", "300"))
+CPU_CHILD_TIMEOUT_S = float(os.environ.get("TIP_BENCH_CPU_TIMEOUT_S", "210"))
+
+
+def _child_measure() -> None:
+    """Runs inside the measurement subprocess; prints one JSON line."""
+    import numpy as np
+
     import jax
     import jax.numpy as jnp
 
@@ -33,23 +65,10 @@ def main():
     from simple_tip_tpu.utils.device_watchdog import ensure_responsive_backend
 
     enable_compilation_cache()
-    # The tunnel to the chip has transient outages; a single failed probe
-    # would silently benchmark the CPU fallback. Retry for a few minutes
-    # before accepting degradation (still bounded: never hangs). An
-    # explicitly CPU-forced run (env set before bench started) skips retries.
-    import os
-
-    cpu_forced = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
-    attempts = max(1, int(os.environ.get("TIP_BENCH_RETRIES", "6")))
-    for attempt in range(attempts):
-        platform = ensure_responsive_backend(timeout_s=90.0)
-        if platform != "cpu" or cpu_forced or attempt == attempts - 1:
-            break
-        os.environ.pop("JAX_PLATFORMS", None)  # undo the fallback for retry
-        import jax
-
-        jax.config.update("jax_platforms", None)
-        time.sleep(120)
+    platform = ensure_responsive_backend(
+        timeout_s=float(os.environ.get("TIP_BENCH_PROBE_TIMEOUT_S", "75"))
+    )
+    on_cpu = platform == "cpu"
 
     from simple_tip_tpu.models import MnistConvNet
     from simple_tip_tpu.models.train import init_params
@@ -63,16 +82,20 @@ def main():
     # bfloat16 compute is the TPU-native scoring configuration (MXU-native;
     # parameters/softmax/taps stay f32). Prediction parity with f32 is
     # enforced by tests/test_model.py::test_bf16_compute_matches_f32.
-    # TIP_BENCH_DTYPE=float32 benches the exact-parity path instead.
-    dtype = os.environ.get("TIP_BENCH_DTYPE", "bfloat16")
+    # CPU has no native bfloat16 units — the emulated path is slower AND not
+    # apples-to-apples with the f32 baseline, so the degraded record
+    # defaults to float32.
+    dtype = os.environ.get("TIP_BENCH_DTYPE", "float32" if on_cpu else "bfloat16")
     model = MnistConvNet(compute_dtype=None if dtype == "float32" else dtype)
     params = init_params(
         MnistConvNet(), jax.random.PRNGKey(0), np.zeros((1, 28, 28, 1), np.float32)
     )
 
     # Batch 32k saturates the chip (measured: 4k -> 785k/s, 16k -> 1.45M/s,
-    # 32k -> 2.87M/s, 64k -> 2.97M/s); stay at the knee, not the plateau.
-    batch = 32768
+    # 32k -> 2.87M/s, 64k -> 2.97M/s). On the single-core CPU fallback that
+    # size is unfinishable within the budget (round-1 failure mode), so the
+    # degraded record uses a small batch and adaptive rep counts instead.
+    batch = 2048 if on_cpu else 32768
     x = jnp.asarray(
         np.random.default_rng(0).normal(size=(batch, 28, 28, 1)).astype(np.float32)
     )
@@ -88,38 +111,124 @@ def main():
         order = jnp.argsort(-gini)
         return pred, gini, ms, p, se, order
 
-    # Warmup/compile, drained by a real fetch (see the timed-region note)
+    # Warmup/compile, drained by a real fetch: over the tunnel transport,
+    # block_until_ready alone can return before the device work has really
+    # finished (see SCALING.md), inflating sub-second timings massively.
     np.asarray(tip_score(params, x)[1])
 
-    # Measure: repeated timed rounds, report the best steady-state rate.
-    # The timed region ends with an actual device->host fetch of one output:
-    # over the tunnel transport, block_until_ready alone can return before
-    # the device work has really finished (see SCALING.md), which would
-    # inflate sub-second timings by orders of magnitude.
+    t0 = time.perf_counter()
+    np.asarray(tip_score(params, x)[1])
+    one_rep = time.perf_counter() - t0
+
+    # Size rounds so the whole measurement stays within ~30s even on the
+    # 1-core CPU path, while keeping the accelerator path at its round-1
+    # steady-state shape (20 reps x 5 rounds).
+    reps = max(1, min(20, int(6.0 / max(one_rep, 1e-4))))
+    rounds = 5 if reps >= 5 else 2
+
     best_rate = 0.0
-    for _ in range(5):
-        reps = 20
+    for _ in range(rounds):
         t0 = time.perf_counter()
         for _ in range(reps):
             out = tip_score(params, x)
         np.asarray(out[1])
         dt = time.perf_counter() - t0
-        rate = batch * reps / dt
-        best_rate = max(best_rate, rate)
+        best_rate = max(best_rate, batch * reps / dt)
 
     print(
         json.dumps(
             {
-                "metric": "prioritizer_inputs_per_sec_per_chip",
+                "metric": METRIC,
                 "value": round(best_rate, 1),
                 "unit": "inputs/sec",
                 "vs_baseline": round(best_rate / REFERENCE_ESTIMATE_INPUTS_PER_SEC, 3),
+                "baseline": BASELINE_INFO,
                 "compute_dtype": dtype,
                 "batch": batch,
+                "reps": reps,
+                "platform": platform,
+                "degraded": bool(on_cpu),
             }
-        )
+        ),
+        # stdout is a pipe to the parent (block-buffered): without the flush
+        # a child that wedges in backend teardown at exit would strand the
+        # record in its buffer and the parent would discard a good run.
+        flush=True,
     )
 
 
+def _run_child(extra_env: dict, timeout_s: float):
+    """Launch the measurement child; return its parsed JSON dict or None."""
+    env = os.environ.copy()
+    env.update(extra_env)
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except OSError as e:
+        print(f"bench child failed to spawn: {e}", file=sys.stderr)
+        return None
+    out = err = ""
+    timed_out = False
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        proc.kill()
+        try:
+            # Drain whatever the child already flushed: a child that
+            # measured, printed its record, and THEN wedged in backend
+            # teardown still produced a valid result we must not discard.
+            out, err = proc.communicate(timeout=5)
+        except (subprocess.TimeoutExpired, ValueError, OSError):
+            pass  # wedged in an uninterruptible device call; abandon it
+        print(f"bench child timed out after {timeout_s:.0f}s", file=sys.stderr)
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(rec, dict) and rec.get("metric") == METRIC:
+            return rec
+    if not timed_out:
+        print(
+            f"bench child rc={proc.returncode}, no JSON record "
+            f"(stderr tail: {(err or '').strip()[-400:]})",
+            file=sys.stderr,
+        )
+    return None
+
+
+def main():
+    # Attempt 1: default backend (accelerator if the tunnel is alive — the
+    # child's own subprocess probe degrades it to CPU-with-small-shapes if
+    # not, so this attempt succeeds in both worlds unless the child wedges).
+    rec = _run_child({}, ACCEL_CHILD_TIMEOUT_S)
+    if rec is None and os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
+        # Attempt 2: force CPU outright (covers a child that wedged before
+        # its own probe could save it, e.g. a poisoned plugin init).
+        rec = _run_child({"JAX_PLATFORMS": "cpu"}, CPU_CHILD_TIMEOUT_S)
+    if rec is None:
+        rec = {
+            "metric": METRIC,
+            "value": 0.0,
+            "unit": "inputs/sec",
+            "vs_baseline": 0.0,
+            "baseline": BASELINE_INFO,
+            "degraded": True,
+            "error": "all measurement attempts failed or timed out",
+        }
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv[1:]:
+        _child_measure()
+    else:
+        main()
