@@ -53,6 +53,13 @@ def main() -> int:
     parser.add_argument("--coordinator", default=None, help="host:port of process 0")
     parser.add_argument("--num-processes", type=int, default=None)
     parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument(
+        "--workers",
+        type=int,
+        default=int(os.environ.get("TIP_NUM_WORKERS", "1")),
+        help="per-host worker processes for per-run host work in the "
+        "test_prio/active_learning/at_collection phases",
+    )
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args()
 
@@ -78,7 +85,11 @@ def main() -> int:
             f"choose from {sorted(CASE_STUDIES)}"
         )
 
-    multi_host = args.coordinator is not None or (args.num_processes or 1) > 1
+    multi_host = (
+        args.coordinator is not None
+        or (args.num_processes or 1) > 1
+        or args.process_id is not None
+    )
     if multi_host and (
         args.coordinator is None
         or args.num_processes is None
@@ -153,7 +164,7 @@ def main() -> int:
         for cs_name in case_studies:
             cs = get_case_study(cs_name)
             t0 = time.perf_counter()
-            dispatch_phase(cs, phase, my_runs)
+            dispatch_phase(cs, phase, my_runs, num_workers=max(1, args.workers))
             print(
                 f"[{phase}:{cs_name}] runs {my_runs[0]}..{my_runs[-1]} "
                 f"in {time.perf_counter() - t0:.0f}s"

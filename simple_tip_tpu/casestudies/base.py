@@ -138,8 +138,38 @@ class CaseStudy:
             for i, model_id in enumerate(group):
                 self.save_params(model_id, unstack(stacked, i))
 
-    def run_prio_eval(self, model_ids: List[int]) -> None:
-        """Run the test-prioritization phase for the requested runs."""
+    def _dispatch_workers(self, phase: str, model_ids: List[int], num_workers: int, phase_kwargs=None) -> None:
+        """Fan the phase out over worker processes (the reference's
+        LazyEnsemble axis, reference: src/dnn_test_prio/case_study.py:87-109):
+        host-bound per-run work (LSA float64 KDE, KMeans, artifact IO) then
+        overlaps across runs instead of serializing behind one interpreter."""
+        from simple_tip_tpu.parallel.run_scheduler import (
+            default_worker_platforms,
+            run_phase_parallel,
+        )
+
+        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            local_chips = 0  # keep spawned workers off the accelerator plugin
+        else:
+            local_chips = 0 if jax.default_backend() == "cpu" else jax.local_device_count()
+        run_phase_parallel(
+            self.spec.name,
+            phase,
+            model_ids,
+            num_workers,
+            phase_kwargs=phase_kwargs,
+            worker_platforms=default_worker_platforms(num_workers, local_chips),
+        )
+
+    def run_prio_eval(self, model_ids: List[int], num_workers: int = 1) -> None:
+        """Run the test-prioritization phase for the requested runs.
+
+        ``num_workers > 1`` distributes runs over that many worker
+        processes; each run's artifacts are file-granular and idempotent,
+        so failed ids can simply be re-run."""
+        if num_workers > 1 and len(model_ids) > 1:
+            self._dispatch_workers("test_prio", model_ids, num_workers)
+            return
         (x_train, _), (x_test, y_test), (ood_x, ood_y) = self.spec.loader()
         for model_id in model_ids:
             params = self.load_params(model_id)
@@ -165,6 +195,7 @@ class CaseStudy:
         model_ids: List[int],
         ensemble_retrain: Optional[bool] = None,
         group_size: int = 16,
+        num_workers: int = 1,
     ) -> None:
         """Run the active-learning phase for the requested runs.
 
@@ -175,6 +206,17 @@ class CaseStudy:
         free (3-5x per-model, SCALING.md) but XLA:CPU lowers ~10x slower
         than plain convs — measured 3.2x *slower* than sequential retrains
         on this host — so the CPU backend defaults to sequential."""
+        if num_workers > 1 and len(model_ids) > 1:
+            self._dispatch_workers(
+                "active_learning",
+                model_ids,
+                num_workers,
+                phase_kwargs={
+                    "ensemble_retrain": ensemble_retrain,
+                    "group_size": group_size,
+                },
+            )
+            return
         if ensemble_retrain is None:
             ensemble_retrain = jax.default_backend() != "cpu"
         (x_train, y_train), (x_test, y_test), (ood_x, ood_y) = self.spec.loader()
@@ -240,8 +282,11 @@ class CaseStudy:
                 batch_training_process=batch_training_process,
             )
 
-    def collect_activations(self, model_ids: List[int]) -> None:
+    def collect_activations(self, model_ids: List[int], num_workers: int = 1) -> None:
         """Dump all layer activations (the at_collection phase)."""
+        if num_workers > 1 and len(model_ids) > 1:
+            self._dispatch_workers("at_collection", model_ids, num_workers)
+            return
         (x_train, y_train), (x_test, y_test), (ood_x, ood_y) = self.spec.loader()
         for model_id in model_ids:
             params = self.load_params(model_id)
@@ -312,5 +357,25 @@ CASE_STUDIES = {
 
 
 def get_case_study(name: str) -> CaseStudy:
-    """Look up a case study by name (mnist, fmnist, cifar10, imdb)."""
-    return CaseStudy(CASE_STUDIES[name])
+    """Look up a case study by name (mnist, fmnist, cifar10, imdb).
+
+    Unknown names consult ``TIP_CASE_STUDY_PROVIDER`` (``module:function``),
+    a hook for user-defined case studies: the function receives the name and
+    returns a ``CaseStudy`` (or None to decline). This is the rebuild's
+    counterpart of subclassing the reference's CaseStudy ABC, and it is how
+    worker processes (parallel/run_scheduler.py) reconstruct non-registry
+    case studies by name."""
+    if name in CASE_STUDIES:
+        return CaseStudy(CASE_STUDIES[name])
+    provider = os.environ.get("TIP_CASE_STUDY_PROVIDER", "").strip()
+    if provider:
+        import importlib
+
+        mod_name, _, attr = provider.partition(":")
+        cs = getattr(importlib.import_module(mod_name), attr)(name)
+        if cs is not None:
+            return cs
+    raise KeyError(
+        f"unknown case study {name!r} (registry: {sorted(CASE_STUDIES)}; "
+        f"set TIP_CASE_STUDY_PROVIDER=module:function for custom ones)"
+    )

@@ -14,6 +14,7 @@ Usage:
 
 import argparse
 import logging
+import os
 import sys
 from typing import List
 
@@ -68,17 +69,21 @@ def _run_eval(which: str, case_studies=ALL_CASE_STUDIES):
         raise ValueError(f"Unknown eval type: {which}")
 
 
-def dispatch_phase(cs, phase: str, runs):
+def dispatch_phase(cs, phase: str, runs, num_workers: int = 1):
     """Run one non-evaluation phase on a CaseStudy (shared by the CLI and
-    scripts/full_study.py so the phase->method mapping lives in one place)."""
+    scripts/full_study.py so the phase->method mapping lives in one place).
+
+    ``num_workers`` fans per-run host work out over worker processes
+    (parallel/run_scheduler.py); training ignores it — its parallel axis is
+    the vmapped ensemble sharded over the device mesh."""
     if phase == "training":
         cs.train(runs)
     elif phase == "test_prio":
-        cs.run_prio_eval(runs)
+        cs.run_prio_eval(runs, num_workers=num_workers)
     elif phase == "active_learning":
-        cs.run_active_learning_eval(runs)
+        cs.run_active_learning_eval(runs, num_workers=num_workers)
     elif phase == "at_collection":
-        cs.collect_activations(runs)
+        cs.collect_activations(runs, num_workers=num_workers)
     else:
         raise ValueError(f"Unknown phase: {phase}")
 
@@ -96,6 +101,13 @@ def main(argv=None) -> int:
         help="run ids: '0', '0-4', '0,3,7', or -1 for all 100",
     )
     parser.add_argument("--eval", choices=EVALS, help="evaluation to run (phase=evaluation)")
+    parser.add_argument(
+        "--workers",
+        type=int,
+        default=int(os.environ.get("TIP_NUM_WORKERS", "1")),
+        help="worker processes for per-run host work in the test_prio/"
+        "active_learning/at_collection phases (default TIP_NUM_WORKERS or 1)",
+    )
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -126,14 +138,35 @@ def main(argv=None) -> int:
     # Degrade loudly to CPU when the accelerator is wedged or its transport
     # is down (observed: multi-hour tunnel outages hang every device op, or
     # fail backend init mid-phase) instead of dying partway through a run.
+    intended_cpu = os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
     platform = ensure_responsive_backend()
     if platform == "cpu":
         logging.getLogger(__name__).warning("running on the CPU backend")
+    if platform == "cpu" and not intended_cpu:
+        # Unintended degradation. For the multi-hour phases, silently
+        # converting an accelerator study into a vastly slower CPU run is
+        # worse than stopping: require an explicit opt-in, and say so on
+        # stdout (not just the log).
+        print(
+            "WARNING: accelerator unresponsive — degraded to the CPU backend",
+            flush=True,
+        )
+        if args.phase in ("training", "active_learning", "at_collection") and (
+            os.environ.get("TIP_ALLOW_CPU_FALLBACK") != "1"
+        ):
+            print(
+                f"Refusing to run the long '{args.phase}' phase on the CPU "
+                f"fallback (it would be slower by orders of magnitude). "
+                f"Set TIP_ALLOW_CPU_FALLBACK=1 to allow, or retry when the "
+                f"accelerator is back.",
+                flush=True,
+            )
+            return 2
 
     from simple_tip_tpu.casestudies import get_case_study
 
     cs = get_case_study(args.case_study)
-    dispatch_phase(cs, args.phase, runs)
+    dispatch_phase(cs, args.phase, runs, num_workers=max(1, args.workers))
     print("Done.")
     return 0
 

@@ -1,0 +1,225 @@
+"""Run-level host parallelism: worker processes over model ids.
+
+TPU-native counterpart of the reference's LazyEnsemble process scheduler
+(reference: src/dnn_test_prio/case_study.py:87-109, which forks
+``num_processes`` workers, each loading model ``i`` from disk and running a
+picklable per-model function). The host-bound half of the prio/AL phases —
+float64 KDE fit/eval for LSA, KMeans+silhouette for pc-mmdsa, artifact IO —
+does not ride the accelerator, so without this axis it serializes across the
+100 runs no matter how fast the chip is.
+
+Design:
+
+- ``spawn`` (never ``fork``): a forked child would inherit an initialized
+  JAX backend and the tunnel transport state, which is unsafe and, during an
+  outage, wedged. Each worker is a fresh interpreter that re-imports the
+  package (the persistent XLA compilation cache makes re-compiles cheap).
+- Work is a queue of model ids, not a pre-chunked split, so a slow run does
+  not strand its worker's remaining ids behind it.
+- Platform policy: the first ``local_chips`` workers inherit the parent's
+  default backend (they get the accelerator); the rest are pinned to CPU
+  with the jax.config binding (the env var alone loses to sitecustomize's
+  plugin registration). On this deployment that means one accelerator
+  worker + N-1 CPU workers; on a real multi-chip host, per-chip pinning can
+  be expressed with ``TIP_WORKER_PLATFORMS`` (comma list cycled over
+  workers, entries ``default`` or ``cpu``).
+- Failures are per-model-id: a worker exception (or a worker death) marks
+  that id failed and the scheduler raises ONE error at the end listing the
+  failed ids. Artifacts are file-granular and idempotent, so re-running
+  exactly the failed ids is safe — same restart contract as the reference's
+  filesystem bus.
+"""
+
+import logging
+import multiprocessing as mp
+import os
+import queue as queue_mod
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# Registered phase runners, by name so the spawn pickling stays trivial.
+# Each maps (case_study_obj, [model_id], kwargs) -> None and must itself be
+# single-process (num_workers forced to 1 inside the worker).
+
+
+def _phase_test_prio(cs, ids, **kw):
+    cs.run_prio_eval(ids, num_workers=1, **kw)
+
+
+def _phase_active_learning(cs, ids, **kw):
+    cs.run_active_learning_eval(ids, num_workers=1, **kw)
+
+
+def _phase_at_collection(cs, ids, **kw):
+    cs.collect_activations(ids, num_workers=1, **kw)
+
+
+def _phase_test_sleep(
+    cs,
+    ids,
+    seconds=0.5,
+    marker_dir=None,
+    fail_ids=(),
+    barrier_n=0,
+    barrier_timeout=120.0,
+    **kw,
+):
+    """Scheduler-test phase: sleeps, records a [start, end] interval marker.
+
+    Sleeping (not spinning) lets the concurrency-overlap test pass on a
+    1-core host; ``fail_ids`` exercises the per-id failure path. With
+    ``barrier_n`` > 0, the phase first rendezvouses until that many DISTINCT
+    worker pids have arrived (filesystem barrier) — without real
+    concurrency, one worker could drain the whole queue while the other is
+    still paying interpreter startup, making interval overlap flaky.
+    """
+    for i in ids:
+        if i in set(fail_ids):
+            raise RuntimeError(f"synthetic failure for run {i}")
+        if marker_dir and barrier_n:
+            with open(os.path.join(marker_dir, f"arrived_{os.getpid()}"), "w"):
+                pass
+            deadline = time.time() + barrier_timeout
+            while time.time() < deadline:
+                arrived = [
+                    f for f in os.listdir(marker_dir) if f.startswith("arrived_")
+                ]
+                if len(arrived) >= barrier_n:
+                    break
+                time.sleep(0.05)
+        start = time.time()
+        time.sleep(seconds)
+        if marker_dir:
+            with open(os.path.join(marker_dir, f"run_{i}.txt"), "w") as f:
+                f.write(f"{start} {time.time()} {os.getpid()}")
+
+
+PHASES = {
+    "test_prio": _phase_test_prio,
+    "active_learning": _phase_active_learning,
+    "at_collection": _phase_at_collection,
+    "_test_sleep": _phase_test_sleep,
+}
+
+
+def _worker_main(case_study, phase, work_q, done_q, phase_kwargs, env_overrides):
+    """Entry point of one spawned worker process."""
+    os.environ.update(env_overrides)
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # Make the CPU pin binding before any backend init: on deployments
+        # whose sitecustomize pre-registers an accelerator plugin the env
+        # var alone silently loses, and a wedged tunnel then hangs the
+        # worker at its first device op.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from simple_tip_tpu.casestudies.base import get_case_study
+    from simple_tip_tpu.config import enable_compilation_cache
+
+    enable_compilation_cache()
+    cs = get_case_study(case_study)
+    fn = PHASES[phase]
+    while True:
+        try:
+            model_id = work_q.get_nowait()
+        except queue_mod.Empty:
+            return
+        try:
+            fn(cs, [model_id], **phase_kwargs)
+            done_q.put((model_id, None))
+        except BaseException as e:  # noqa: BLE001 — reported, then re-queued by caller
+            done_q.put((model_id, repr(e)))
+
+
+def default_worker_platforms(num_workers: int, local_chips: int) -> List[str]:
+    """Platform per worker: chips-first, CPU for the overflow workers.
+
+    ``TIP_WORKER_PLATFORMS`` (comma list of ``default``/``cpu``, cycled)
+    overrides the policy, e.g. for per-chip pinning setups.
+    """
+    override = os.environ.get("TIP_WORKER_PLATFORMS", "").strip()
+    if override:
+        entries = [e.strip() for e in override.split(",") if e.strip()]
+        return [entries[i % len(entries)] for i in range(num_workers)]
+    n_accel = min(max(local_chips, 0), num_workers)
+    return ["default"] * n_accel + ["cpu"] * (num_workers - n_accel)
+
+
+def run_phase_parallel(
+    case_study: str,
+    phase: str,
+    model_ids: List[int],
+    num_workers: int,
+    phase_kwargs: Optional[Dict] = None,
+    worker_platforms: Optional[List[str]] = None,
+) -> None:
+    """Run ``phase`` for ``model_ids`` across ``num_workers`` processes.
+
+    Raises ``RuntimeError`` at the end if any id failed, naming every failed
+    id and its error; completed ids keep their artifacts either way.
+    """
+    if phase not in PHASES:
+        raise ValueError(f"unknown phase {phase!r}; one of {sorted(PHASES)}")
+    num_workers = max(1, min(num_workers, len(model_ids)))
+    if worker_platforms is None:
+        worker_platforms = ["default"] * num_workers
+    phase_kwargs = dict(phase_kwargs or {})
+
+    ctx = mp.get_context("spawn")
+    work_q = ctx.Queue()
+    done_q = ctx.Queue()
+    for m in model_ids:
+        work_q.put(m)
+
+    workers = []
+    for i in range(num_workers):
+        env = {}
+        if worker_platforms[i % len(worker_platforms)] == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+        w = ctx.Process(
+            target=_worker_main,
+            args=(case_study, phase, work_q, done_q, phase_kwargs, env),
+            daemon=True,
+        )
+        w.start()
+        workers.append(w)
+    logger.info(
+        "[%s] %s: %d runs across %d workers (platforms: %s)",
+        case_study,
+        phase,
+        len(model_ids),
+        num_workers,
+        worker_platforms[:num_workers],
+    )
+
+    results: Dict[int, Optional[str]] = {}
+    while len(results) < len(model_ids):
+        try:
+            model_id, err = done_q.get(timeout=5.0)
+            results[model_id] = err
+            if err is None:
+                logger.info("[%s] %s: run %d done", case_study, phase, model_id)
+            else:
+                logger.error("[%s] %s: run %d FAILED: %s", case_study, phase, model_id, err)
+        except queue_mod.Empty:
+            if not any(w.is_alive() for w in workers):
+                break  # a worker died without reporting (e.g. segfault/OOM-kill)
+    for w in workers:
+        w.join(timeout=30)
+        if w.is_alive():  # pragma: no cover — wedged worker (dead tunnel)
+            logger.error("worker pid %s wedged; terminating", w.pid)
+            w.terminate()
+
+    failed = {m: e for m, e in results.items() if e is not None}
+    missing = [m for m in model_ids if m not in results]
+    if failed or missing:
+        parts = [f"run {m}: {e}" for m, e in sorted(failed.items())]
+        parts += [f"run {m}: worker died without reporting" for m in missing]
+        raise RuntimeError(
+            f"{phase} failed for {len(parts)}/{len(model_ids)} runs "
+            f"(completed runs kept their artifacts; re-run the failed ids): "
+            + "; ".join(parts)
+        )

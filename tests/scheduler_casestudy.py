@@ -1,0 +1,42 @@
+"""Tiny case-study provider used by the run-scheduler tests.
+
+Lives in its own importable module (not inside a test file) because the
+scheduler's spawned worker processes must reconstruct the case study by name
+via ``TIP_CASE_STUDY_PROVIDER=scheduler_casestudy:provide`` — the test puts
+this directory on the workers' PYTHONPATH.
+"""
+
+import numpy as np
+
+
+def provide(name: str):
+    """Provider hook: return the tiny case study for 'schedmnist'."""
+    if name != "schedmnist":
+        return None
+
+    from simple_tip_tpu.casestudies.base import CaseStudy, CaseStudySpec
+    from simple_tip_tpu.data import synthetic
+    from simple_tip_tpu.models import MnistConvNet
+    from simple_tip_tpu.models.train import TrainConfig
+
+    def loader():
+        (x_train, y_train), (x_test, y_test) = synthetic.image_classification(
+            seed=7, n_train=192, n_test=96, shape=(16, 16, 1), num_classes=4
+        )
+        x_corr = synthetic.corrupt_images(x_test, seed=8, severity=0.6)
+        return (x_train, y_train), (x_test, y_test), (x_corr, y_test)
+
+    spec = CaseStudySpec(
+        name="schedmnist",
+        model_factory=lambda: MnistConvNet(num_classes=4),
+        loader=loader,
+        train_cfg=TrainConfig(
+            batch_size=32, epochs=2, learning_rate=5e-3, validation_split=0.1
+        ),
+        nc_activation_layers=(0, 1, 2, 3),
+        sa_activation_layers=(3,),
+        prediction_badge_size=48,
+        num_classes=4,
+        al_num_selected=8,
+    )
+    return CaseStudy(spec)

@@ -1,0 +1,341 @@
+"""END-TO-END prio-phase parity against the reference's engine semantics.
+
+The kernel-level oracle (test_reference_oracle.py) proves each metric matches
+on identical inputs. This module closes the remaining gap (round-1 verdict):
+it runs OUR engine's full test_prio phase on one trained Flax model, then
+feeds the SAME activations/predictions through the reference's handler flow —
+rebuilt here on the reference's own numpy core classes, since the reference
+handler modules import TensorFlow which this environment does not have — and
+requires identical scores, CAM orders, and APFD values per approach
+(reference: src/dnn_test_prio/eval_prioritization.py:62-215,
+handler_coverage.py:20-132, handler_surprise.py:19-117,
+plotters/eval_apfd_table.py:43-131).
+
+Exclusions, forced by the reference's own nondeterminism (not ours):
+``pc-mlsa`` and ``pc-mmdsa`` construct UNSEEDED sklearn estimators
+(``GaussianMixture(n_components=3)``, ``KMeans(n_clusters=i)`` — reference:
+src/core/surprise.py:509,123), so even two reference runs disagree; their
+engine-level plumbing is covered by the shape/validity assertions of the e2e
+suite and their math by the blob-recovery kernel oracles. ``VR`` scores come
+from our MC-dropout pass (no reference implementation runnable without TF);
+the APFD comparison still covers the VR *artifact -> order -> APFD* path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+# Importing the fixture registers it in this module for pytest (the oracle
+# module also carries the skip-if-no-reference logic we want).
+from test_reference_oracle import REFERENCE_DIR, ref  # noqa: F401
+
+pytestmark = pytest.mark.skipif(
+    not (REFERENCE_DIR / "src" / "core").is_dir(),
+    reason="reference implementation not available to act as oracle",
+)
+
+NC_CONFIGS = [
+    "NBC_0", "NBC_0.5", "NBC_1",
+    "SNAC_0", "SNAC_0.5", "SNAC_1",
+    "NAC_0", "NAC_0.75",
+    "TKNC_1", "TKNC_2", "TKNC_3",
+    "KMNC_2",
+]
+EXACT_SA = ["dsa", "pc-lsa", "pc-mdsa"]  # deterministic reference variants
+NUM_SC_BUCKETS = 1000
+
+
+@pytest.fixture(scope="module")
+def engine_run(tmp_path_factory):
+    """Train one model, run OUR engine's prio phase, and hand back everything
+    the reference-side recomputation needs."""
+    tmp = tmp_path_factory.mktemp("engine_parity")
+    old_assets = os.environ.get("TIP_ASSETS")
+    old_data = os.environ.get("TIP_DATA_DIR")
+    os.environ["TIP_ASSETS"] = str(tmp / "assets")
+    os.environ["TIP_DATA_DIR"] = str(tmp / "nonexistent-data")
+    try:
+        from flax import linen as nn
+        import jax.numpy as jnp
+
+        from simple_tip_tpu.casestudies.base import CaseStudy, CaseStudySpec
+        from simple_tip_tpu.data import synthetic
+        from simple_tip_tpu.models.convnet import glorot
+        from simple_tip_tpu.models.train import TrainConfig
+
+        class ParityNet(nn.Module):
+            """Tap-contract model with a NARROW (12-wide) dense SA tap: the
+            reference's conv-layer taps are rank-deficient at tiny scale
+            (1024 collinear post-relu features -> the KDE's stabilization
+            gives up, densities 0, LSA = +inf on BOTH sides — parity holds
+            but proves nothing about the finite path). 400 samples/class
+            over 12 generically full-rank features keeps LSA finite, so SC
+            bucketing and CAM are exercised for real."""
+
+            num_classes: int = 4
+            dropout_rate: float = 0.25
+            has_dropout = True
+
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                taps = {}
+                x = nn.relu(
+                    nn.Conv(8, (3, 3), padding="VALID", kernel_init=glorot)(x)
+                )
+                taps[0] = x
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+                taps[1] = x
+                x = x.reshape((x.shape[0], -1))
+                taps[2] = x
+                x = nn.relu(nn.Dense(12, kernel_init=glorot)(x))
+                taps[3] = x
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+                taps[4] = x
+                probs = nn.softmax(
+                    nn.Dense(self.num_classes, kernel_init=glorot)(x).astype(
+                        jnp.float32
+                    )
+                )
+                taps[5] = probs
+                return probs, taps
+
+        def loader():
+            # High sample noise on purpose: the default stamps are disjoint,
+            # which (a) gives 100% nominal accuracy — APFD over zero faults
+            # is NaN on both sides, voiding the comparison — and (b) leaves
+            # near-constant relu features whose singular covariance sends
+            # the KDE into its degraded all-zeros mode.
+            (x_train, y_train), (x_test, y_test) = synthetic.image_classification(
+                seed=13,
+                n_train=1600,
+                n_test=160,
+                shape=(16, 16, 1),
+                num_classes=4,
+                noise=0.75,
+            )
+            x_corr = synthetic.corrupt_images(x_test, seed=14, severity=0.6)
+            return (x_train, y_train), (x_test, y_test), (x_corr, y_test)
+
+        spec = CaseStudySpec(
+            name="parmnist",
+            model_factory=ParityNet,
+            loader=loader,
+            train_cfg=TrainConfig(
+                batch_size=64, epochs=2, learning_rate=5e-3, validation_split=0.1
+            ),
+            nc_activation_layers=(0, 1, 2, 3),
+            sa_activation_layers=(3,),
+            prediction_badge_size=160,
+            num_classes=4,
+            al_num_selected=8,
+        )
+        cs = CaseStudy(spec)
+        cs.train([0])
+        cs.run_prio_eval([0])
+
+        from simple_tip_tpu.engine.model_handler import BaseModel
+
+        params = cs.load_params(0)
+        (x_train, _), (x_test, y_test), (ood_x, ood_y) = loader()
+
+        bm_nc = BaseModel(
+            cs.model_def, params, activation_layers=[0, 1, 2, 3], batch_size=160
+        )
+        bm_sa = BaseModel(
+            cs.model_def,
+            params,
+            activation_layers=[3],
+            batch_size=160,
+            include_last_layer=True,
+        )
+        datasets = {"nominal": x_test, "ood": ood_x}
+        labels = {"nominal": y_test, "ood": ood_y}
+        yield {
+            "cs": cs,
+            "prio_dir": os.path.join(os.environ["TIP_ASSETS"], "priorities"),
+            "train_nc_ats": bm_nc.get_activations(x_train),
+            "test_nc_ats": {k: bm_nc.get_activations(v) for k, v in datasets.items()},
+            "train_sa": bm_sa.get_activations(x_train),
+            "test_sa": {k: bm_sa.get_activations(v) for k, v in datasets.items()},
+            "labels": labels,
+        }
+    finally:
+        for k, v in (("TIP_ASSETS", old_assets), ("TIP_DATA_DIR", old_data)):
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _art(run, ds, kind):
+    return np.load(os.path.join(run["prio_dir"], f"parmnist_{ds}_0_{kind}.npy"))
+
+
+def test_neuron_coverage_engine_matches_reference(ref, engine_run):
+    """All 12 NC configs: scores and CAM orders equal the reference handler
+    flow (aggregate train stats -> metric instances -> profiles -> cam),
+    reference: handler_coverage.py:33-132."""
+    nc = ref["nc"]
+    prio = ref["prio"]
+    train_ats = engine_run["train_nc_ats"]
+    # Reference aggregate stats: per-layer elementwise min/max and Welford
+    # SAMPLE std (welford.var_s, ddof=1) — aggregate_statistics.py:46-66.
+    mins = [a.min(axis=0) for a in train_ats]
+    maxs = [a.max(axis=0) for a in train_ats]
+    stds = [np.std(a, axis=0, ddof=1) for a in train_ats]
+
+    metrics = {
+        "NBC_0": nc.NBC(mins=mins, maxs=maxs, stds=stds, scaler=0),
+        "NBC_0.5": nc.NBC(mins=mins, maxs=maxs, stds=stds, scaler=0.5),
+        "NBC_1": nc.NBC(mins=mins, maxs=maxs, stds=stds, scaler=1),
+        "SNAC_0": nc.SNAC(maxs=maxs, stds=stds, scaler=0),
+        "SNAC_0.5": nc.SNAC(maxs=maxs, stds=stds, scaler=0.5),
+        "SNAC_1": nc.SNAC(maxs=maxs, stds=stds, scaler=1),
+        "NAC_0": nc.NAC(cov_threshold=0.0),
+        "NAC_0.75": nc.NAC(cov_threshold=0.75),
+        "TKNC_1": nc.TKNC(top_neurons=1),
+        "TKNC_2": nc.TKNC(top_neurons=2),
+        "TKNC_3": nc.TKNC(top_neurons=3),
+        "KMNC_2": nc.KMNC(mins, maxs, sections=2),
+    }
+    assert sorted(metrics) == sorted(NC_CONFIGS)
+    for ds_name, test_ats in engine_run["test_nc_ats"].items():
+        for metric_id, metric in metrics.items():
+            ref_scores, ref_profiles = metric(test_ats)
+            ours_scores = _art(engine_run, ds_name, f"{metric_id}_scores")
+            np.testing.assert_allclose(
+                ours_scores,
+                ref_scores,
+                rtol=1e-5,
+                atol=1e-6,
+                err_msg=f"{metric_id} scores diverge on {ds_name}",
+            )
+            ref_cam = np.array(list(prio.cam(ref_scores, ref_profiles)))
+            ours_cam = _art(engine_run, ds_name, f"{metric_id}_cam_order")
+            np.testing.assert_array_equal(
+                ours_cam, ref_cam, err_msg=f"{metric_id} CAM diverges on {ds_name}"
+            )
+
+
+def test_surprise_engine_matches_reference(ref, engine_run):
+    """Deterministic SA variants: scores, SC profiles, CAM orders equal the
+    reference handler flow, reference: handler_surprise.py:22-117."""
+    s = ref["surprise"]
+    prio = ref["prio"]
+    train_ats, train_out = engine_run["train_sa"][:-1], engine_run["train_sa"][-1]
+    train_pred = np.argmax(train_out, axis=1)
+
+    builders = {
+        "dsa": lambda: s.DSA(train_ats, train_pred, subsampling=0.3),
+        "pc-lsa": lambda: s.MultiModalSA.build_by_class(
+            train_ats, train_pred, lambda x, y: s.LSA(x)
+        ),
+        "pc-mdsa": lambda: s.MultiModalSA.build_by_class(
+            train_ats, train_pred, lambda x, y: s.MDSA(x)
+        ),
+    }
+    assert sorted(builders) == sorted(EXACT_SA)
+    # DSA runs on the chip in f32 (chunked MXU matmuls) vs the reference's
+    # f64 numpy, so its scores carry float noise; the host-f64 paths (LSA
+    # KDE, MDSA) are held to tighter bounds.
+    score_tol = {"dsa": (2e-3, 1e-5), "pc-lsa": (1e-4, 1e-6), "pc-mdsa": (1e-4, 1e-6)}
+    for sa_name, build in builders.items():
+        sa = build()
+        for ds_name, outs in engine_run["test_sa"].items():
+            test_ats, test_pred = outs[:-1], np.argmax(outs[-1], axis=1)
+            ref_scores = np.asarray(sa(test_ats, test_pred))
+            ours_scores = _art(engine_run, ds_name, f"{sa_name}_scores")
+            rtol, atol = score_tol[sa_name]
+            np.testing.assert_allclose(
+                ours_scores,
+                ref_scores,
+                rtol=rtol,
+                atol=atol,
+                err_msg=f"{sa_name} scores diverge on {ds_name}",
+            )
+            assert np.isfinite(ref_scores).all(), (
+                f"{sa_name} produced non-finite scores on {ds_name}; the "
+                f"fixture's narrow SA tap is meant to keep the KDE well-posed"
+            )
+            # CAM from OUR stored scores through the REFERENCE mapper+cam:
+            # isolates the engine plumbing (bucket upper bound = max observed
+            # SA, profile construction, cam wiring) from the f32/f64 kernel
+            # noise above — identical-input kernel parity for the mapper and
+            # cam themselves is test_reference_oracle.py's job.
+            mapper = s.SurpriseCoverageMapper(NUM_SC_BUCKETS, np.max(ours_scores))
+            profiles = mapper.get_coverage_profile(ours_scores)
+            ref_cam = np.array(list(prio.cam(ours_scores, profiles)))
+            ours_cam = _art(engine_run, ds_name, f"{sa_name}_cam_order")
+            np.testing.assert_array_equal(
+                ours_cam, ref_cam, err_msg=f"{sa_name} CAM diverges on {ds_name}"
+            )
+
+
+def test_fault_predictors_and_apfd_match_reference(ref, engine_run):
+    """Misclassification masks, the four point-prediction quantifier scores,
+    and the final APFD value per approach equal the reference math
+    (reference: eval_prioritization.py:193-215, handler_model.py:23-86,
+    plotters/eval_apfd_table.py:43-131)."""
+    apfd = ref["apfd"]
+    from simple_tip_tpu.plotters import eval_apfd_table
+    from simple_tip_tpu.plotters.utils import APPROACHES
+
+    for ds_name, y in engine_run["labels"].items():
+        outs = engine_run["test_sa"][ds_name]
+        probs = np.asarray(outs[-1], dtype=np.float64)
+        pred = np.argmax(probs, axis=1)
+        np.testing.assert_array_equal(
+            _art(engine_run, ds_name, "is_misclassified"),
+            pred != np.asarray(y).flatten(),
+        )
+        # uwiz point-prediction quantifier math under as_confidence=False
+        # (reference handler_model.py:136): confidence quantifiers
+        # (MaxSoftmax, PCS) are reported NEGATED; uncertainty quantifiers
+        # (DeepGini, SoftmaxEntropy base-2) are reported as-is.
+        p_sorted = np.sort(probs, axis=1)
+        expected = {
+            "deep_gini": 1.0 - np.sum(probs**2, axis=1),
+            "softmax": -p_sorted[:, -1],
+            "pcs": -(p_sorted[:, -1] - p_sorted[:, -2]),
+            "softmax_entropy": -np.sum(
+                probs * np.log2(probs, where=probs > 0, out=np.zeros_like(probs)),
+                axis=1,
+            ),
+        }
+        for unc_id, exp in expected.items():
+            np.testing.assert_allclose(
+                _art(engine_run, ds_name, f"uncertainty_{unc_id}"),
+                exp,
+                rtol=1e-5,
+                atol=1e-6,
+                err_msg=f"uncertainty_{unc_id} diverges on {ds_name}",
+            )
+
+    # Full APFD sweep: our plotter's value per approach must equal the
+    # reference apfd_from_order applied to the order derived per the
+    # reference's own rules (scores -> argsort(-scores), cam -> as stored).
+    df = eval_apfd_table.run(case_studies=["parmnist"])
+    for ds_name in ("nominal", "ood"):
+        mask = _art(engine_run, ds_name, "is_misclassified")
+        assert mask.any(), (
+            f"no misclassifications on {ds_name}: the APFD comparison would "
+            f"be vacuous (every value NaN); strengthen the fixture's label noise"
+        )
+        for approach in APPROACHES:
+            if approach in ("deep_gini", "softmax", "pcs", "softmax_entropy", "VR"):
+                scores = _art(engine_run, ds_name, f"uncertainty_{approach}")
+                order = np.argsort(-scores)
+            elif approach.endswith("-cam"):
+                order = _art(engine_run, ds_name, f"{approach[:-4]}_cam_order")
+            else:
+                scores = _art(engine_run, ds_name, f"{approach}_scores")
+                order = np.argsort(-scores)
+            expected_apfd = apfd.apfd_from_order(mask, order)
+            got = df.loc[
+                df.index.get_level_values("approach") == approach,
+                ("parmnist", ds_name),
+            ].iloc[0]
+            assert float(got) == pytest.approx(expected_apfd, abs=1e-9), (
+                f"APFD diverges for {approach} on {ds_name}"
+            )

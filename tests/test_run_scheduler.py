@@ -1,0 +1,142 @@
+"""Run-level host-parallelism tests (the reference's LazyEnsemble axis,
+reference: src/dnn_test_prio/case_study.py:87-109).
+
+Covers: two workers are genuinely concurrent (rendezvous barrier + interval
+overlap — wall-clock speedup is not assertable on this 1-core host), per-id
+failure reporting with completed ids keeping artifacts, the worker-platform
+policy, and worker-vs-sequential artifact equality for a real prio phase.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from simple_tip_tpu.parallel.run_scheduler import (
+    default_worker_platforms,
+    run_phase_parallel,
+)
+
+
+def _read_marker(marker_dir, i):
+    with open(os.path.join(marker_dir, f"run_{i}.txt")) as f:
+        start, end, pid = f.read().split()
+    return float(start), float(end), int(pid)
+
+
+def test_workers_run_concurrently_and_failures_are_per_id(tmp_path):
+    """4 synthetic runs over 2 workers: run 1 fails, the rest complete, and
+    sleep intervals from two distinct pids overlap (true concurrency)."""
+    marker_dir = str(tmp_path / "markers")
+    os.makedirs(marker_dir)
+    with pytest.raises(RuntimeError) as exc_info:
+        run_phase_parallel(
+            "mnist",  # registry name; the sleep phase never touches its data
+            "_test_sleep",
+            model_ids=[0, 1, 2, 3],
+            num_workers=2,
+            phase_kwargs={
+                "seconds": 1.0,
+                "marker_dir": marker_dir,
+                "fail_ids": (1,),
+                "barrier_n": 2,
+            },
+        )
+    msg = str(exc_info.value)
+    assert "run 1" in msg and "synthetic failure" in msg
+    assert "1/4" in msg  # exactly one failed id
+
+    intervals = {i: _read_marker(marker_dir, i) for i in (0, 2, 3)}
+    pids = {pid for _, _, pid in intervals.values()}
+    assert len(pids) == 2, f"expected two distinct worker pids, got {pids}"
+    overlapping = any(
+        a_start < b_end and b_start < a_end and a_pid != b_pid
+        for a_start, a_end, a_pid in intervals.values()
+        for b_start, b_end, b_pid in intervals.values()
+    )
+    assert overlapping, f"no cross-worker interval overlap: {intervals}"
+
+
+def test_worker_platform_policy(monkeypatch):
+    monkeypatch.delenv("TIP_WORKER_PLATFORMS", raising=False)
+    # chips-first, CPU overflow
+    assert default_worker_platforms(4, local_chips=1) == ["default", "cpu", "cpu", "cpu"]
+    assert default_worker_platforms(2, local_chips=4) == ["default", "default"]
+    assert default_worker_platforms(3, local_chips=0) == ["cpu", "cpu", "cpu"]
+    # explicit override, cycled
+    monkeypatch.setenv("TIP_WORKER_PLATFORMS", "default,cpu")
+    assert default_worker_platforms(3, local_chips=0) == ["default", "cpu", "default"]
+
+
+def test_unknown_phase_rejected():
+    with pytest.raises(ValueError, match="unknown phase"):
+        run_phase_parallel("mnist", "no_such_phase", [0], num_workers=1)
+
+
+@pytest.fixture()
+def sched_env(tmp_path, monkeypatch):
+    """Environment for spawned workers: assets dir, provider hook, and this
+    tests directory on the workers' import path."""
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "assets"))
+    monkeypatch.setenv("TIP_DATA_DIR", str(tmp_path / "nonexistent-data"))
+    monkeypatch.setenv("TIP_CASE_STUDY_PROVIDER", "scheduler_casestudy:provide")
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    repo_root = os.path.dirname(tests_dir)
+    extra = os.pathsep.join([tests_dir, repo_root])
+    existing = os.environ.get("PYTHONPATH")
+    monkeypatch.setenv(
+        "PYTHONPATH", extra + (os.pathsep + existing if existing else "")
+    )
+    if tests_dir not in sys.path:  # parent process too (sequential leg)
+        sys.path.insert(0, tests_dir)
+    return tmp_path
+
+
+def test_prio_phase_workers_match_sequential(sched_env):
+    """Real test_prio for two runs via 2 worker processes produces byte-equal
+    artifacts to the sequential in-process path (same seeds, same backend)."""
+    from scheduler_casestudy import provide
+
+    cs = provide("schedmnist")
+    cs.train([0, 1])
+
+    prio = os.path.join(os.environ["TIP_ASSETS"], "priorities")
+
+    cs.run_prio_eval([0, 1], num_workers=2)
+    parallel_arrays = {
+        f: np.load(os.path.join(prio, f), allow_pickle=False)
+        for f in sorted(os.listdir(prio))
+    }
+    assert parallel_arrays, "worker run produced no artifacts"
+    for f in parallel_arrays:
+        os.remove(os.path.join(prio, f))
+
+    cs.run_prio_eval([0, 1], num_workers=1)
+    sequential_files = sorted(os.listdir(prio))
+    assert sequential_files == sorted(parallel_arrays)
+    for f in sequential_files:
+        seq = np.load(os.path.join(prio, f), allow_pickle=False)
+        np.testing.assert_array_equal(
+            seq, parallel_arrays[f], err_msg=f"artifact mismatch: {f}"
+        )
+
+
+def test_active_learning_sequential_retrain_path(sched_env):
+    """The production default on CPU hosts is ensemble_retrain=False
+    (sequential per-selection retrains); exercise that branch end-to-end —
+    the e2e suite pins ensemble_retrain=True for the batched glue, which
+    left this default path uncovered (round-1 advisor finding)."""
+    from scheduler_casestudy import provide
+
+    cs = provide("schedmnist")
+    cs.train([0])
+    cs.run_active_learning_eval([0], ensemble_retrain=False)
+
+    al = os.path.join(os.environ["TIP_ASSETS"], "active_learning")
+    al_files = os.listdir(al)
+    assert "schedmnist_0_original_na.pickle" in al_files
+    assert "schedmnist_0_random_nominal.pickle" in al_files
+    assert "schedmnist_0_deep_gini_ood.pickle" in al_files
+    # 39 approaches + random -> 40 selections x 2 splits + 1 original
+    assert len(al_files) == 40 * 2 + 1
