@@ -65,13 +65,21 @@ def engine_run(tmp_path_factory):
         from simple_tip_tpu.models.train import TrainConfig
 
         class ParityNet(nn.Module):
-            """Tap-contract model with a NARROW (12-wide) dense SA tap: the
-            reference's conv-layer taps are rank-deficient at tiny scale
-            (1024 collinear post-relu features -> the KDE's stabilization
-            gives up, densities 0, LSA = +inf on BOTH sides — parity holds
-            but proves nothing about the finite path). 400 samples/class
-            over 12 generically full-rank features keeps LSA finite, so SC
-            bucketing and CAM are exercised for real."""
+            """Tap-contract model with a NARROW (12-wide) TANH dense SA tap.
+
+            Two conditioning hazards drive this design, both of which send
+            the KDE into its degraded all-zeros mode (LSA = +inf on BOTH
+            sides — parity holds but proves nothing about the finite path):
+            (a) wide conv taps are rank-deficient at tiny scale (1024
+            collinear post-relu features), and (b) even a narrow
+            relu(Dense(12)) tap leaves ~5/12 units DEAD per class (zero
+            variance -> zero eigenvalue), which the reference's
+            diagonal-replacing stabilization (stable_kde.py:55-77) cannot
+            recover from. tanh has no dead-unit mode: over noisy inputs
+            every feature is a diffeomorphic image of a full-rank affine
+            projection, so the per-class covariance is strictly PD
+            (measured min eigenvalue ~1e-4 after bandwidth scaling) and
+            LSA stays finite, exercising SC bucketing and CAM for real."""
 
             num_classes: int = 4
             dropout_rate: float = 0.25
@@ -88,7 +96,7 @@ def engine_run(tmp_path_factory):
                 taps[1] = x
                 x = x.reshape((x.shape[0], -1))
                 taps[2] = x
-                x = nn.relu(nn.Dense(12, kernel_init=glorot)(x))
+                x = nn.tanh(nn.Dense(12, kernel_init=glorot)(x))
                 taps[3] = x
                 x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
                 taps[4] = x
@@ -102,17 +110,19 @@ def engine_run(tmp_path_factory):
 
         def loader():
             # High sample noise on purpose: the default stamps are disjoint,
-            # which (a) gives 100% nominal accuracy — APFD over zero faults
-            # is NaN on both sides, voiding the comparison — and (b) leaves
-            # near-constant relu features whose singular covariance sends
-            # the KDE into its degraded all-zeros mode.
+            # which gives 100% nominal accuracy — APFD over zero faults is
+            # NaN on both sides, voiding the comparison. noise=0.7 at 3
+            # epochs measures 4/160 nominal misclassifications with all 4
+            # classes predicted and per-class tap covariances strictly PD
+            # (higher noise at 2 epochs left classes unpredicted, which
+            # empties a MultiModalSA modal).
             (x_train, y_train), (x_test, y_test) = synthetic.image_classification(
                 seed=13,
                 n_train=1600,
                 n_test=160,
                 shape=(16, 16, 1),
                 num_classes=4,
-                noise=0.75,
+                noise=0.7,
             )
             x_corr = synthetic.corrupt_images(x_test, seed=14, severity=0.6)
             return (x_train, y_train), (x_test, y_test), (x_corr, y_test)
@@ -122,7 +132,7 @@ def engine_run(tmp_path_factory):
             model_factory=ParityNet,
             loader=loader,
             train_cfg=TrainConfig(
-                batch_size=64, epochs=2, learning_rate=5e-3, validation_split=0.1
+                batch_size=64, epochs=3, learning_rate=5e-3, validation_split=0.1
             ),
             nc_activation_layers=(0, 1, 2, 3),
             sa_activation_layers=(3,),
@@ -225,6 +235,11 @@ def test_surprise_engine_matches_reference(ref, engine_run):
     prio = ref["prio"]
     train_ats, train_out = engine_run["train_sa"][:-1], engine_run["train_sa"][-1]
     train_pred = np.argmax(train_out, axis=1)
+    assert len(np.unique(train_pred)) == 4, (
+        "fixture model no longer predicts all 4 classes on the train set; "
+        "MultiModalSA.build_by_class would silently lose a modal — strengthen "
+        "the fixture (more epochs / less noise)"
+    )
 
     builders = {
         "dsa": lambda: s.DSA(train_ats, train_pred, subsampling=0.3),
