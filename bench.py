@@ -21,13 +21,16 @@ multi-hour accelerator-tunnel outage. Structure:
 - If both children fail, the parent still emits a degraded zero record.
 
 Baseline: the reference wall-clocks its TIP phase on a multi-GPU TF-2.6 box
-but publishes no per-input rate (SURVEY.md section 6). ``vs_baseline``
-compares against a documented ESTIMATE of 10,000 inputs/sec for the
-reference's f32 TF predict+quantify path (batch-32 Keras predict with uwiz
-quantifiers) — the JSON carries ``baseline: {estimate: true, dtype:
-"float32"}`` so the ratio is never mistaken for a measured apples-to-apples
-number (our default compute dtype is bfloat16; TIP_BENCH_DTYPE=float32
-benches the exact-parity path instead).
+but publishes no per-input rate (SURVEY.md section 6), and TF is not
+installed here. The baseline is therefore MEASURED as the closest runnable
+proxy — the reference's exact MNIST predict+quantify math in float32 numpy
+at badge size 32, on this host — by scripts/measure_reference_baseline.py,
+which writes ``BASELINE_MEASURED.json`` (picked up here when present, and
+labeled ``estimate: false, proxy: numpy-same-host`` in the emitted record).
+If that file is absent the pre-round-3 documented ESTIMATE of 10,000
+inputs/sec is used and labeled ``estimate: true`` so the ratio is never
+mistaken for a measurement. Our default compute dtype is bfloat16;
+TIP_BENCH_DTYPE=float32 benches the exact-parity path instead.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
@@ -41,12 +44,34 @@ import time
 REFERENCE_ESTIMATE_INPUTS_PER_SEC = 10_000.0
 
 METRIC = "prioritizer_inputs_per_sec_per_chip"
-BASELINE_INFO = {
-    "inputs_per_sec": REFERENCE_ESTIMATE_INPUTS_PER_SEC,
-    "estimate": True,
-    "dtype": "float32",
-    "source": "documented estimate for the reference's TF GPU predict+quantify path",
-}
+
+
+def _load_baseline():
+    """(rate, info-dict) from BASELINE_MEASURED.json, else the estimate."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BASELINE_MEASURED.json"
+    )
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        if isinstance(rec, dict):
+            rate = float(rec.get("inputs_per_sec", 0))
+            if rate > 0:
+                rec.setdefault("source", "scripts/measure_reference_baseline.py")
+                return rate, rec
+    except (OSError, ValueError, TypeError):
+        # never let a corrupt baseline file kill the bench: the outage-proof
+        # contract is ONE JSON line under every condition
+        pass
+    return REFERENCE_ESTIMATE_INPUTS_PER_SEC, {
+        "inputs_per_sec": REFERENCE_ESTIMATE_INPUTS_PER_SEC,
+        "estimate": True,
+        "dtype": "float32",
+        "source": "documented estimate for the reference's TF GPU predict+quantify path",
+    }
+
+
+BASELINE_RATE, BASELINE_INFO = _load_baseline()
 
 # Wall-clock budgets (seconds). Worst case total:
 # accelerator child (300) + cpu child (210) + overhead << any driver budget.
@@ -141,7 +166,7 @@ def _child_measure() -> None:
                 "metric": METRIC,
                 "value": round(best_rate, 1),
                 "unit": "inputs/sec",
-                "vs_baseline": round(best_rate / REFERENCE_ESTIMATE_INPUTS_PER_SEC, 3),
+                "vs_baseline": round(best_rate / BASELINE_RATE, 3),
                 "baseline": BASELINE_INFO,
                 "compute_dtype": dtype,
                 "batch": batch,

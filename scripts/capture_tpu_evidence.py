@@ -1,0 +1,232 @@
+"""Opportunistic TPU perf-evidence capture (round-2 verdict, missing #3).
+
+The TPU behind this deployment's tunnel has multi-hour outages, and both
+previous rounds ended with only a degraded CPU bench record. This harness
+makes TPU evidence capture a one-command, any-time operation so it can run
+the moment the tunnel is healthy, not only at round end:
+
+1. Probe the tunnel (subprocess, bounded) — exit immediately when down.
+2. Run ``bench.py``; persist a NON-degraded record to ``bench_tpu.json``.
+3. Drive a multi-run end-to-end study (training + test_prio +
+   active_learning on one case study, default mnist x 10 runs) on the real
+   chip, appending per-(run, phase) wall-clock to ``STUDY_r03.json`` AFTER
+   EVERY PHASE — an outage mid-study still leaves machine-readable partial
+   evidence — and finishing with per-phase means and a projection
+   reconciled against SCALING.md's full-study estimate.
+
+Every child is subprocess-bounded; the parent never imports jax (a wedged
+device call must never take the harness down). Artifacts land under
+``TIP_ASSETS`` (default ``/tmp/tpu_study_assets``) and are reused on
+re-runs (idempotent phases), so repeated invocations across outage windows
+converge to the full study.
+
+Usage: python scripts/capture_tpu_evidence.py [--runs 10] [--case-study mnist]
+       [--skip-study] [--phase-timeout 5400]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROBE = (
+    "import jax, jax.numpy as jnp; "
+    "jax.jit(lambda x: x + 1)(jnp.ones(8)).block_until_ready(); "
+    "print(jax.devices()[0].platform)"
+)
+
+
+def _probe_platform(timeout_s: float = 90.0) -> str:
+    """Default-backend platform via a bounded subprocess; 'down' on any failure."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", PROBE],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=os.environ.copy(),
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip().splitlines()[-1]
+    except (subprocess.TimeoutExpired, OSError, subprocess.SubprocessError):
+        pass
+    return "down"
+
+
+def _run_bench() -> dict:
+    """bench.py in a subprocess; returns its parsed record ({} on failure)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True,
+            text=True,
+            timeout=900,
+            env=os.environ.copy(),
+            cwd=REPO,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return {}
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            if isinstance(rec, dict) and "metric" in rec:
+                return rec
+        except ValueError:
+            continue
+    return {}
+
+
+def _load_study(path: str) -> dict:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"phases": {}, "complete": False}
+
+
+def _save_study(path: str, study: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(study, f, indent=1)
+    os.replace(tmp, path)
+
+
+def _cli_phase(phase: str, case_study: str, run_id: int, timeout_s: float) -> dict:
+    """One CLI phase for one run in a bounded subprocess; returns its record."""
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "simple_tip_tpu.cli",
+                "--phase",
+                phase,
+                "--case-study",
+                case_study,
+                "--runs",
+                str(run_id),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=os.environ.copy(),
+            cwd=REPO,
+        )
+        return {
+            "ok": out.returncode == 0,
+            "seconds": round(time.time() - t0, 1),
+            "error": None if out.returncode == 0 else out.stderr.strip()[-400:],
+        }
+    except subprocess.TimeoutExpired:
+        return {
+            "ok": False,
+            "seconds": round(time.time() - t0, 1),
+            "error": f"timed out after {timeout_s:.0f}s (tunnel wedge?)",
+        }
+    except OSError as e:
+        return {"ok": False, "seconds": round(time.time() - t0, 1), "error": repr(e)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--runs", type=int, default=10)
+    ap.add_argument("--case-study", default="mnist")
+    ap.add_argument("--skip-study", action="store_true")
+    ap.add_argument("--skip-bench", action="store_true")
+    ap.add_argument("--phase-timeout", type=float, default=5400.0)
+    ap.add_argument("--study-json", default=os.path.join(REPO, "STUDY_r03.json"))
+    ap.add_argument("--bench-json", default=os.path.join(REPO, "bench_tpu.json"))
+    args = ap.parse_args()
+
+    platform = _probe_platform()
+    print(f"tunnel probe: platform={platform}")
+    if platform in ("down", "cpu"):
+        print("accelerator not reachable — nothing captured, try again later")
+        return 1
+
+    os.environ.setdefault("TIP_ASSETS", "/tmp/tpu_study_assets")
+    os.environ.setdefault("TIP_DATA_DIR", os.path.join(REPO, "datasets"))
+
+    if not args.skip_bench:
+        rec = _run_bench()
+        if rec and not rec.get("degraded", True):
+            rec["captured_unix"] = round(time.time(), 1)
+            with open(args.bench_json, "w") as f:
+                json.dump(rec, f, indent=1)
+            print(f"bench: NON-degraded {rec['value']} {rec['unit']} "
+                  f"({rec.get('platform')}) -> {args.bench_json}")
+        else:
+            print(f"bench came back degraded/empty ({rec.get('platform') if rec else 'no record'}); not persisted")
+
+    if args.skip_study:
+        return 0
+
+    study = _load_study(args.study_json)
+    study.setdefault("case_study", args.case_study)
+    study.setdefault("runs_requested", args.runs)
+    study["platform"] = platform
+    phases = study["phases"]
+    for phase in ("training", "test_prio", "active_learning"):
+        per_run = phases.setdefault(phase, {})
+        for run_id in range(args.runs):
+            key = str(run_id)
+            if per_run.get(key, {}).get("ok"):
+                continue  # already captured in an earlier window
+            print(f"[{phase}] run {run_id} ...", flush=True)
+            rec = _cli_phase(phase, args.case_study, run_id, args.phase_timeout)
+            per_run[key] = rec
+            _save_study(args.study_json, study)
+            if not rec["ok"]:
+                print(f"[{phase}] run {run_id} FAILED: {rec['error']}")
+                if "timed out" in (rec["error"] or ""):
+                    # the tunnel likely dropped mid-study: stop burning the
+                    # window; this script is resumable.
+                    _finalize(study, args)
+                    return 2
+
+    _finalize(study, args)
+    return 0
+
+
+def _finalize(study: dict, args) -> None:
+    """Per-phase means + 100-run/4-case-study projection vs SCALING.md."""
+    summary = {}
+    for phase, per_run in study["phases"].items():
+        secs = [r["seconds"] for r in per_run.values() if r.get("ok")]
+        if secs:
+            summary[phase] = {
+                "runs_ok": len(secs),
+                "mean_s": round(sum(secs) / len(secs), 1),
+                "total_s": round(sum(secs), 1),
+            }
+    study["summary"] = summary
+    complete = all(
+        summary.get(p, {}).get("runs_ok", 0) >= args.runs
+        for p in ("training", "test_prio", "active_learning")
+    )
+    study["complete"] = complete
+    if summary:
+        per_run_s = sum(p["mean_s"] for p in summary.values())
+        # 100 runs x 4 case studies, embarrassingly parallel over chips.
+        study["projection"] = {
+            "one_run_all_phases_s": round(per_run_s, 1),
+            "full_study_single_chip_h": round(per_run_s * 100 * 4 / 3600.0, 2),
+            "full_study_16_chips_h": round(per_run_s * 100 * 4 / 16 / 3600.0, 2),
+            "note": (
+                "phase wall-clock includes host-bound work (LSA f64 KDE, "
+                "KMeans, IO) measured on this 1-core host; SCALING.md's "
+                "projection assumed per-run host work overlapped across "
+                "worker processes"
+            ),
+        }
+    _save_study(args.study_json, study)
+    print(json.dumps({"summary": summary, "complete": complete}))
+
+
+if __name__ == "__main__":
+    main()

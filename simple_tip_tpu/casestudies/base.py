@@ -147,11 +147,13 @@ class CaseStudy:
             default_worker_platforms,
             run_phase_parallel,
         )
+        from simple_tip_tpu.utils.device_watchdog import probe_local_chips
 
-        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-            local_chips = 0  # keep spawned workers off the accelerator plugin
-        else:
-            local_chips = 0 if jax.default_backend() == "cpu" else jax.local_device_count()
+        # Chip count via a SUBPROCESS probe: the parent must not initialize
+        # the accelerator backend right before spawning a 'default'-platform
+        # worker that needs exclusive device access (and during a tunnel
+        # outage an in-parent init would hang this dispatcher itself).
+        local_chips = probe_local_chips()
         run_phase_parallel(
             self.spec.name,
             phase,

@@ -9,6 +9,13 @@ Behavioral contract matches the reference (reference: src/core/text_corruptor.py
 - Per-sentence seed = md5(text) + seed, so corruption of a text is independent
   of the order/subset of the dataset; higher severity strictly adds
   corruptions on top of those applied at lower severity.
+- Reference quirk preserved verbatim: the sampling weights vector is ordered
+  [typo, autocomplete, autocorrect, synonym] while the enum numbers TYPO=0,
+  SYNONYM=1, AUTOCOMPLETE=2, AUTOCORRECT=3 — so ``autocomplete_weight``
+  effectively weights SYNONYM, ``autocorrect_weight`` weights AUTOCOMPLETE and
+  ``synonym_weight`` weights AUTOCORRECT (reference: src/core/
+  text_corruptor.py:128-146 vs :92-102). Changing this would change every
+  IMDB-C corruption draw, so parity wins over readability.
 - Dictionary = the ``dictionary_size`` most frequent words (len>4, not
   numeric) of a base dataset; pickle/npy caching keyed by dataset hash.
 
@@ -231,14 +238,28 @@ class TextCorruptor:
         return distances
 
     def load_bad_translations(self, thesaurus_path: Optional[str] = None) -> Dict[str, List[str]]:
-        """Load the synonym map from a local jsonl thesaurus
-        ({"word": ..., "synonyms": [...]} per line). No network access: when no
-        file is found the thesaurus is empty and SYNONYM corruptions degrade
-        to TYPO (the reference's own no-synonym fallback)."""
+        """Load the synonym map from a jsonl thesaurus
+        ({"word": ..., "synonyms": [...]} per line). Resolution order:
+        explicit ``thesaurus_path`` > ``TIP_DATA_DIR/en_thesaurus.jsonl`` (a
+        user-supplied wordnet export, matching the reference's downloaded one,
+        reference: src/core/text_corruptor.py:412-446) > the bundled offline
+        asset ``simple_tip_tpu/data/assets/en_thesaurus.jsonl`` (hand-curated,
+        built by scripts/build_thesaurus.py — zero-egress default). Only if
+        ALL are missing does the thesaurus come up empty, in which case
+        SYNONYM corruptions degrade to TYPO (the reference's own no-synonym
+        fallback)."""
         candidates = [thesaurus_path] if thesaurus_path else []
         from simple_tip_tpu.config import data_folder
 
         candidates.append(os.path.join(data_folder(), "en_thesaurus.jsonl"))
+        candidates.append(
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "data",
+                "assets",
+                "en_thesaurus.jsonl",
+            )
+        )
         path = next((p for p in candidates if p and os.path.isfile(p)), None)
         if path is None:
             logger.warning(

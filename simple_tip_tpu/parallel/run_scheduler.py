@@ -28,6 +28,20 @@ Design:
   failed ids. Artifacts are file-granular and idempotent, so re-running
   exactly the failed ids is safe — same restart contract as the reference's
   filesystem bus.
+- Wedge recovery: workers announce each id before running it, so the
+  scheduler knows what is in flight. An id that exceeds ``run_timeout_s``
+  (default ``TIP_RUN_TIMEOUT_S``, 3600s) — the documented mid-run tunnel
+  drop, where a device call blocks forever instead of erroring — gets its
+  worker terminated and is requeued ONCE onto a freshly spawned CPU-pinned
+  replacement worker; a second timeout marks the id failed. A worker that
+  dies without reporting (segfault/OOM-kill) is handled the same way. This
+  is the component's reason to exist on a box with multi-hour tunnel
+  outages: the scheduler must never spin forever on a wedged-alive worker.
+- Reproducibility note: with the chips-first platform policy, WHICH run id
+  lands on the accelerator worker is queue-timing-dependent, so chip (bf16/
+  f32) vs host (f64) numerics can differ run-to-run between invocations.
+  Set ``TIP_WORKER_PLATFORMS=cpu`` for reproducibility-sensitive studies
+  (see SCALING.md).
 """
 
 import logging
@@ -38,6 +52,10 @@ import time
 from typing import Dict, List, Optional
 
 logger = logging.getLogger(__name__)
+
+# Grace added to run_timeout_s before presuming a silent worker pool wedged
+# at startup: a fresh spawn pays interpreter + jax import (tens of seconds).
+_STARTUP_GRACE_S = 120.0
 
 # Registered phase runners, by name so the spawn pickling stays trivial.
 # Each maps (case_study_obj, [model_id], kwargs) -> None and must itself be
@@ -96,15 +114,35 @@ def _phase_test_sleep(
                 f.write(f"{start} {time.time()} {os.getpid()}")
 
 
+def _phase_test_wedge(cs, ids, marker_dir=None, wedge_ids=(), always_wedge=False, **kw):
+    """Scheduler-test phase emulating a wedged device call: the FIRST attempt
+    at a ``wedge_ids`` id blocks far beyond any test timeout (a tunnel-outage
+    stand-in — the call never returns, it must be terminated); the retry
+    (requeued onto a fresh worker, which sees the attempt marker) completes.
+    With ``always_wedge``, every attempt at a wedge id blocks (the
+    both-attempts-dead path).
+    """
+    for i in ids:
+        attempt_marker = os.path.join(marker_dir, f"attempt_{i}")
+        first_attempt = not os.path.exists(attempt_marker)
+        with open(attempt_marker, "a") as f:
+            f.write(f"{os.getpid()}\n")
+        if i in set(wedge_ids) and (first_attempt or always_wedge):
+            time.sleep(3600)  # "wedged": only SIGTERM ends this attempt
+        with open(os.path.join(marker_dir, f"run_{i}.txt"), "w") as f:
+            f.write(f"{time.time()} {time.time()} {os.getpid()}")
+
+
 PHASES = {
     "test_prio": _phase_test_prio,
     "active_learning": _phase_active_learning,
     "at_collection": _phase_at_collection,
     "_test_sleep": _phase_test_sleep,
+    "_test_wedge": _phase_test_wedge,
 }
 
 
-def _worker_main(case_study, phase, work_q, done_q, phase_kwargs, env_overrides):
+def _worker_main(case_study, phase, work_q, done_q, stop_event, phase_kwargs, env_overrides):
     """Entry point of one spawned worker process."""
     os.environ.update(env_overrides)
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
@@ -124,14 +162,29 @@ def _worker_main(case_study, phase, work_q, done_q, phase_kwargs, env_overrides)
     fn = PHASES[phase]
     while True:
         try:
-            model_id = work_q.get_nowait()
+            # Blocking with timeout (NOT get_nowait): queue items travel
+            # through a feeder thread, so an early get_nowait can see Empty
+            # before already-put ids reach the pipe and silently strand them.
+            # The stop event — set by the scheduler only once every id has
+            # resolved — is the exit signal.
+            model_id = work_q.get(timeout=0.5)
         except queue_mod.Empty:
-            return
+            if stop_event.is_set():
+                return
+            continue
+        # Announce the claim so the scheduler can detect a wedged/killed
+        # worker holding this id and requeue it.
+        done_q.put(("start", model_id, os.getpid()))
         try:
             fn(cs, [model_id], **phase_kwargs)
-            done_q.put((model_id, None))
-        except BaseException as e:  # noqa: BLE001 — reported, then re-queued by caller
-            done_q.put((model_id, repr(e)))
+            done_q.put(("done", model_id, None))
+        except (KeyboardInterrupt, SystemExit) as e:
+            # Report the interrupted id, then actually stop — an interrupted
+            # worker must not keep draining the queue.
+            done_q.put(("done", model_id, repr(e)))
+            raise
+        except BaseException as e:  # noqa: BLE001 — reported; scheduler decides
+            done_q.put(("done", model_id, repr(e)))
 
 
 def default_worker_platforms(num_workers: int, local_chips: int) -> List[str]:
@@ -155,62 +208,181 @@ def run_phase_parallel(
     num_workers: int,
     phase_kwargs: Optional[Dict] = None,
     worker_platforms: Optional[List[str]] = None,
+    run_timeout_s: Optional[float] = None,
 ) -> None:
     """Run ``phase`` for ``model_ids`` across ``num_workers`` processes.
 
-    Raises ``RuntimeError`` at the end if any id failed, naming every failed
-    id and its error; completed ids keep their artifacts either way.
+    ``run_timeout_s`` bounds one id's attempt on one worker (default env
+    ``TIP_RUN_TIMEOUT_S``, 3600): past it the worker is presumed wedged in a
+    dead device call, gets terminated, and the id is requeued once onto a
+    fresh CPU-pinned worker. Raises ``RuntimeError`` at the end if any id
+    failed, naming every failed id and its error; completed ids keep their
+    artifacts either way.
     """
     if phase not in PHASES:
         raise ValueError(f"unknown phase {phase!r}; one of {sorted(PHASES)}")
     num_workers = max(1, min(num_workers, len(model_ids)))
     if worker_platforms is None:
         worker_platforms = ["default"] * num_workers
+    if run_timeout_s is None:
+        run_timeout_s = float(os.environ.get("TIP_RUN_TIMEOUT_S", "3600"))
     phase_kwargs = dict(phase_kwargs or {})
 
     ctx = mp.get_context("spawn")
     work_q = ctx.Queue()
+    # Retries ride a SEPARATE queue read only by the CPU-pinned replacement
+    # workers: putting a retry back on the shared queue would let an idle
+    # default-platform worker — possibly on the same dead tunnel — steal it
+    # and wedge again, burning the id's single retry.
+    retry_q = ctx.Queue()
     done_q = ctx.Queue()
+    stop_event = ctx.Event()
     for m in model_ids:
         work_q.put(m)
 
-    workers = []
-    for i in range(num_workers):
-        env = {}
-        if worker_platforms[i % len(worker_platforms)] == "cpu":
-            env["JAX_PLATFORMS"] = "cpu"
+    workers: List = []
+
+    def _spawn(platform: str, queue=work_q):
+        env = {"JAX_PLATFORMS": "cpu"} if platform == "cpu" else {}
         w = ctx.Process(
             target=_worker_main,
-            args=(case_study, phase, work_q, done_q, phase_kwargs, env),
+            args=(case_study, phase, queue, done_q, stop_event, phase_kwargs, env),
             daemon=True,
         )
         w.start()
         workers.append(w)
+        return w
+
+    for i in range(num_workers):
+        _spawn(worker_platforms[i % len(worker_platforms)])
     logger.info(
-        "[%s] %s: %d runs across %d workers (platforms: %s)",
+        "[%s] %s: %d runs across %d workers (platforms: %s, run timeout %.0fs)",
         case_study,
         phase,
         len(model_ids),
         num_workers,
         worker_platforms[:num_workers],
+        run_timeout_s,
     )
 
     results: Dict[int, Optional[str]] = {}
+    in_flight: Dict[int, Dict] = {}  # id -> {"pid", "deadline"}
+    requeued: set = set()
+
+    def _handle(msg) -> None:
+        kind, model_id, payload = msg
+        if kind == "start":
+            in_flight[model_id] = {
+                "pid": payload,
+                "deadline": time.time() + run_timeout_s,
+            }
+            return
+        in_flight.pop(model_id, None)
+        if model_id in results:
+            return  # late duplicate after a requeue race; first report wins
+        results[model_id] = payload
+        if payload is None:
+            logger.info("[%s] %s: run %d done", case_study, phase, model_id)
+        else:
+            logger.error(
+                "[%s] %s: run %d FAILED: %s", case_study, phase, model_id, payload
+            )
+
+    def _reap_stuck() -> None:
+        """Terminate wedged/dead workers holding an id; requeue once to CPU."""
+        now = time.time()
+        by_pid = {w.pid: w for w in workers}
+        for model_id, info in list(in_flight.items()):
+            w = by_pid.get(info["pid"])
+            worker_dead = w is not None and not w.is_alive()
+            if now <= info["deadline"] and not worker_dead:
+                continue
+            reason = (
+                "worker died mid-run"
+                if worker_dead
+                else f"no result after {run_timeout_s:.0f}s (wedged device call?)"
+            )
+            if w is not None and w.is_alive():
+                logger.error(
+                    "[%s] %s: run %d %s — terminating worker pid %s",
+                    case_study, phase, model_id, reason, w.pid,
+                )
+                w.terminate()
+            in_flight.pop(model_id, None)
+            if model_id in results:
+                continue  # a first attempt already reported; nothing to redo
+            if model_id in requeued:
+                results[model_id] = f"{reason}; already requeued once — giving up"
+                logger.error(
+                    "[%s] %s: run %d failed after requeue", case_study, phase, model_id
+                )
+            else:
+                requeued.add(model_id)
+                logger.warning(
+                    "[%s] %s: requeueing run %d onto a fresh CPU-pinned worker (%s)",
+                    case_study, phase, model_id, reason,
+                )
+                retry_q.put(model_id)
+                _spawn("cpu", queue=retry_q)
+
+    # A worker can also wedge BEFORE claiming anything (tunnel drops during
+    # its jax/plugin init): then in_flight stays empty and no per-id deadline
+    # exists. Track overall progress; past the stall threshold with nothing
+    # in flight, replace the whole stuck pool with CPU-pinned workers once.
+    # The threshold includes a startup grace on top of run_timeout_s so a
+    # small test timeout does not misread normal interpreter+jax startup
+    # (seconds to tens of seconds) as a wedged pool.
+    stall_timeout_s = run_timeout_s + _STARTUP_GRACE_S
+    last_progress = time.time()
+    startup_rescued = False
+
     while len(results) < len(model_ids):
         try:
-            model_id, err = done_q.get(timeout=5.0)
-            results[model_id] = err
-            if err is None:
-                logger.info("[%s] %s: run %d done", case_study, phase, model_id)
-            else:
-                logger.error("[%s] %s: run %d FAILED: %s", case_study, phase, model_id, err)
+            _handle(done_q.get(timeout=1.0))
+            last_progress = time.time()
+            continue
         except queue_mod.Empty:
-            if not any(w.is_alive() for w in workers):
-                break  # a worker died without reporting (e.g. segfault/OOM-kill)
+            pass
+        _reap_stuck()
+        if in_flight:
+            last_progress = time.time()  # per-id deadlines own this case
+        elif time.time() - last_progress > stall_timeout_s:
+            alive = [w for w in workers if w.is_alive()]
+            if alive and not startup_rescued:
+                logger.error(
+                    "[%s] %s: no worker claimed any run for %.0fs — presuming "
+                    "the pool wedged at startup; replacing with CPU-pinned "
+                    "workers",
+                    case_study, phase, stall_timeout_s,
+                )
+                for w in alive:
+                    w.terminate()
+                startup_rescued = True
+                for _ in range(min(num_workers, len(model_ids) - len(results))):
+                    _spawn("cpu")
+                last_progress = time.time()
+            elif alive:
+                logger.error(
+                    "[%s] %s: CPU replacement pool also made no progress for "
+                    "%.0fs — giving up",
+                    case_study, phase, stall_timeout_s,
+                )
+                break
+        if not any(w.is_alive() for w in workers) and not in_flight:
+            # Final drain, then give up: nobody is left to produce results.
+            while True:
+                try:
+                    _handle(done_q.get_nowait())
+                except queue_mod.Empty:
+                    break
+            if len(results) < len(model_ids):
+                break
+
+    stop_event.set()
     for w in workers:
         w.join(timeout=30)
         if w.is_alive():  # pragma: no cover — wedged worker (dead tunnel)
-            logger.error("worker pid %s wedged; terminating", w.pid)
+            logger.error("worker pid %s wedged at shutdown; terminating", w.pid)
             w.terminate()
 
     failed = {m: e for m, e in results.items() if e is not None}

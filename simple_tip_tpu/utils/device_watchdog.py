@@ -32,6 +32,67 @@ _PROBE = (
     "print(jax.devices()[0].platform)"
 )
 
+_CHIP_PROBE = (
+    "import jax, jax.numpy as jnp; "
+    "jax.jit(lambda x: x + 1)(jnp.ones(8)).block_until_ready(); "
+    "print(jax.devices()[0].platform, jax.local_device_count())"
+)
+
+_chip_probe_cache: dict = {}
+
+
+def probe_local_chips(timeout_s: float = 90.0) -> int:
+    """Number of responsive local accelerator chips, WITHOUT initializing any
+    backend in this process.
+
+    The probe runs in a subprocess, so a caller about to spawn
+    'default'-platform workers never grabs the accelerator itself first — on
+    runtimes with exclusive per-process device access a parent-side init
+    would wedge or fail the worker, and during a tunnel outage the parent
+    init itself would hang (round-2 advisor, medium). Returns 0 when CPU is
+    forced via ``JAX_PLATFORMS``, when the default platform is cpu, or when
+    the probe fails or times out. The (timeout-keyed) result is cached: the
+    probe costs a jax import + device init per call.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return 0
+    if timeout_s in _chip_probe_cache:
+        return _chip_probe_cache[timeout_s]
+    chips = 0
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _CHIP_PROBE],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=os.environ.copy(),
+        )
+        try:
+            out, err = proc.communicate(timeout=timeout_s)
+            if proc.returncode == 0 and out.strip():
+                platform, n = out.strip().splitlines()[-1].split()
+                chips = 0 if platform == "cpu" else int(n)
+            else:
+                logger.error(
+                    "chip-count probe exited %s (stderr tail: %s) — assuming 0",
+                    proc.returncode,
+                    (err or "").strip()[-300:],
+                )
+        except subprocess.TimeoutExpired:
+            logger.error(
+                "chip-count probe unresponsive after %.0fs — assuming 0 chips",
+                timeout_s,
+            )
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                logger.error("probe child survived SIGKILL; abandoning it")
+    except (OSError, subprocess.SubprocessError, ValueError) as e:
+        logger.error("chip-count probe could not run (%s) — assuming 0", e)
+    _chip_probe_cache[timeout_s] = chips
+    return chips
+
 
 def ensure_responsive_backend(timeout_s: float = 90.0) -> str:
     """Return the platform that will be used ('tpu', 'cpu', ...).

@@ -89,9 +89,81 @@ def test_autocorrect_returns_near_word(corruptor):
     assert levenshtein(out, "movies") <= 6
 
 
-def test_synonym_degrades_to_typo_without_thesaurus(corruptor):
-    assert corruptor.thesaurus == {}
+def test_bundled_thesaurus_loaded_by_default(corruptor):
+    # No thesaurus_path and no TIP_DATA_DIR file: the bundled offline asset
+    # (simple_tip_tpu/data/assets/en_thesaurus.jsonl) is the default, so
+    # SYNONYM corruptions substitute for real by default (round-2 verdict:
+    # previously every SYNONYM silently degraded to TYPO).
+    assert len(corruptor.thesaurus) > 1000
+    assert "fantastic" in corruptor.thesaurus
+    # loader filter parity: every retained entry has >= 2 synonyms
+    # (reference text_corruptor.py:437-440 keeps only len(synonyms) > 1)
+    assert all(len(s) >= 2 for s in corruptor.thesaurus.values())
+
+
+def test_synonym_substitutes_from_thesaurus(corruptor):
     word = "fantastic"
+    out = corruptor._corrupt_synonym(word, seed=5)
+    assert out in corruptor.thesaurus[word]
+    # deterministic (md5-salted choice, reference text_corruptor.py semantics)
+    assert out == corruptor._corrupt_synonym(word, seed=5)
+    assert corruptor._corrupt_synonym(word, seed=6) in corruptor.thesaurus[word]
+
+
+def test_tip_data_dir_thesaurus_wins_over_bundled(tmp_path, monkeypatch):
+    import json
+
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    (data_dir / "en_thesaurus.jsonl").write_text(
+        json.dumps({"word": "fantastic", "synonyms": ["userword1", "userword2"]})
+        + "\n"
+    )
+    monkeypatch.setenv("TIP_DATA_DIR", str(data_dir))
+    c = TextCorruptor(
+        base_dataset=BASE, cache_dir=str(tmp_path / "cache"), dictionary_size=50
+    )
+    assert set(c.thesaurus) == {"fantastic"}
+    assert sorted(c.thesaurus["fantastic"]) == ["userword1", "userword2"]
+
+
+def test_corrupt_applies_synonyms_end_to_end(corruptor):
+    # The IMDB-C build path (data/imdb_prep.py) runs corrupt() with default
+    # weights; here synonym-only weights prove the SYNONYM branch is live
+    # end-to-end (non-degraded IMDB-C), not just at the _corrupt_word level.
+    from simple_tip_tpu.ops.text_corruptor import CorruptionWeights
+
+    texts = ["fantastic wonderful brilliant gorgeous hilarious performances"]
+    # Reference quirk preserved verbatim: the weights vector is ordered
+    # [typo, autocomplete, autocorrect, synonym] but CorruptionType numbers
+    # TYPO=0, SYNONYM=1, AUTOCOMPLETE=2, AUTOCORRECT=3 — so weight index 1
+    # (autocomplete_weight) is the one that actually selects SYNONYM
+    # (reference text_corruptor.py:128-146 vs :92-102).
+    out = corruptor.corrupt(
+        texts,
+        severity=1.0,
+        seed=2,
+        weights=CorruptionWeights(
+            typo_weight=0,
+            autocomplete_weight=1,
+            autocorrect_weight=0,
+            synonym_weight=0,
+        ),
+        force_recalculate=True,
+    )[0].split()
+    orig = texts[0].split()
+    syn_hits = sum(
+        o in corruptor.thesaurus and n in corruptor.thesaurus[o]
+        for o, n in zip(orig, out)
+    )
+    assert syn_hits >= 4
+
+
+def test_synonym_degrades_to_typo_without_thesaurus(corruptor):
+    # Emulate the no-asset environment (all thesaurus candidates missing):
+    # SYNONYM must fall back to TYPO, the reference's own no-synonym path.
+    word = "uncoveredword"
+    assert word not in corruptor.thesaurus
     out = corruptor._corrupt_synonym(word, seed=5)
     assert len(out) == len(word)
     assert sum(a != b for a, b in zip(out, word)) == 1
