@@ -1,0 +1,26 @@
+# Reproducible environment for simple_tip_tpu — the version set every number
+# in SCALING.md / BENCH_r*.json / BASELINE_MEASURED.json was recorded under
+# (the reference pins its own stack the same way, reference: Dockerfile:1).
+#
+# CPU image by default; on a TPU VM install the matching jax TPU wheel
+# instead of the plain one (same pinned version):
+#   pip install 'jax[tpu]==0.9.0' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+FROM python:3.12.12-slim-bookworm
+
+# Native toolchain for the C++ kernels (ops/native, built via ctypes cc at
+# first import) — g++ 12 is what the recorded numbers used.
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+COPY requirements.lock /tmp/requirements.lock
+RUN pip install --no-cache-dir -r /tmp/requirements.lock
+
+WORKDIR /workspace
+COPY . /workspace
+RUN pip install --no-cache-dir -e . && python -m pytest tests/ -x -q
+
+# Artifact bus + data mounts (same contract as the reference's /assets):
+#   docker run -v /my/assets:/assets -v /my/datasets:/datasets \
+#     -e TIP_ASSETS=/assets -e TIP_DATA_DIR=/datasets <image> \
+#     python -m simple_tip_tpu.cli --phase training --case-study mnist --runs 0-99

