@@ -145,6 +145,16 @@ def main() -> int:
 
     platform = _probe_platform()
     print(f"tunnel probe: platform={platform}")
+    # Timestamped probe log: a round that ends with no TPU record should at
+    # least carry machine-readable evidence of WHEN the tunnel was tried.
+    try:
+        with open(os.path.join(REPO, "TUNNEL_PROBES.jsonl"), "a") as f:
+            f.write(
+                json.dumps({"unix": round(time.time(), 1), "platform": platform})
+                + "\n"
+            )
+    except OSError:
+        pass
     if platform in ("down", "cpu"):
         print("accelerator not reachable — nothing captured, try again later")
         return 1
