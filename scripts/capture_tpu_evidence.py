@@ -161,6 +161,10 @@ def main() -> int:
 
     os.environ.setdefault("TIP_ASSETS", "/tmp/tpu_study_assets")
     os.environ.setdefault("TIP_DATA_DIR", os.path.join(REPO, "datasets"))
+    # When the study falls back to synthetic stand-ins (no real mounts in
+    # this environment), run them at the reference's full dataset scale so
+    # the per-phase wall-clock honestly reflects a real study's shapes.
+    os.environ.setdefault("TIP_SYNTH_SCALE", "paper")
 
     if not args.skip_bench:
         rec = _run_bench()

@@ -63,6 +63,16 @@ def _warn_synthetic(name: str):
     )
 
 
+def _synth_sizes(default: Tuple[int, int], paper: Tuple[int, int]) -> Tuple[int, int]:
+    """Synthetic stand-in sizes: the fast test-suite ``default``, or the
+    dataset's real ``paper`` scale under ``TIP_SYNTH_SCALE=paper`` — so
+    wall-clock measurements on synthetic data
+    (scripts/capture_tpu_evidence.py) reflect full-study shapes."""
+    if os.environ.get("TIP_SYNTH_SCALE", "").strip().lower() == "paper":
+        return paper
+    return default
+
+
 def _ood_mix(x_test, y_test, x_corr, y_corr, seed: int = 0):
     ood_x = np.concatenate((x_test, x_corr), axis=0)
     ood_y = np.concatenate((y_test, y_corr), axis=0)
@@ -70,7 +80,13 @@ def _ood_mix(x_test, y_test, x_corr, y_corr, seed: int = 0):
     return ood_x[perm], ood_y[perm]
 
 
-def _load_image_case(name: str, shape, synth_seed: int, scale_uint8: bool) -> Triple:
+def _load_image_case(
+    name: str,
+    shape,
+    synth_seed: int,
+    scale_uint8: bool,
+    paper_sizes: Tuple[int, int] = (60000, 10000),
+) -> Triple:
     npz = _npz_path(f"{name}.npz")
     c_img = _npz_path(f"{name}_c_images.npy")
     c_lab = _npz_path(f"{name}_c_labels.npy")
@@ -138,8 +154,9 @@ def _load_image_case(name: str, shape, synth_seed: int, scale_uint8: bool) -> Tr
                     logger.warning("could not cache %s corrupted set (%s)", name, e)
     else:
         _warn_synthetic(name)
+        n_train, n_test = _synth_sizes((12000, 2000), paper_sizes)
         (x_train, y_train), (x_test, y_test) = synthetic.image_classification(
-            seed=synth_seed, n_train=12000, n_test=2000, shape=shape
+            seed=synth_seed, n_train=n_train, n_test=n_test, shape=shape
         )
         x_corr = synthetic.corrupt_images(x_test, seed=synth_seed + 1)
         y_corr = y_test.copy()
@@ -164,7 +181,13 @@ def load_fmnist() -> Triple:
 @lru_cache(maxsize=None)
 def load_cifar10() -> Triple:
     """CIFAR-10 + CIFAR-10-C sample (or synthetic stand-ins)."""
-    return _load_image_case("cifar10", (32, 32, 3), synth_seed=33, scale_uint8=True)
+    return _load_image_case(
+        "cifar10",
+        (32, 32, 3),
+        synth_seed=33,
+        scale_uint8=True,
+        paper_sizes=(50000, 10000),  # CIFAR-10's real split is 50k/10k
+    )
 
 
 @lru_cache(maxsize=None)
@@ -185,8 +208,9 @@ def load_imdb(maxlen: int = 100, vocab_size: int = 2000) -> Triple:
         x_corr = np.load(os.path.join(folder, "x_corrupted.npy")).astype(np.int32)
     else:
         _warn_synthetic("imdb")
+        n_train, n_test = _synth_sizes((10000, 2500), (25000, 25000))
         (x_train, y_train), (x_test, y_test) = synthetic.token_classification(
-            seed=44, n_train=10000, n_test=2500, maxlen=maxlen, vocab_size=vocab_size
+            seed=44, n_train=n_train, n_test=n_test, maxlen=maxlen, vocab_size=vocab_size
         )
         x_corr = synthetic.corrupt_tokens(x_test, seed=45, vocab_size=vocab_size)
     ood_x, ood_y = _ood_mix(x_test, y_test, x_corr, y_test.copy(), seed=0)

@@ -26,15 +26,19 @@ def image_classification(
     h, w, c = shape
 
     # Per-class fixed random template with localized high-intensity stamp.
-    templates = rng.uniform(0.0, 0.4, size=(num_classes, h, w, c))
+    # float32 throughout: at TIP_SYNTH_SCALE=paper (e.g. 50k x 32x32x3) f64
+    # intermediates would peak at multiple GB and the result is lru_cached
+    # for the process lifetime.
+    templates = rng.uniform(0.0, 0.4, size=(num_classes, h, w, c)).astype(np.float32)
     for cls in range(num_classes):
         r = (cls * 7919) % (h - 8)
         col = (cls * 104729) % (w - 8)
-        templates[cls, r : r + 8, col : col + 8, :] += 0.55
+        templates[cls, r : r + 8, col : col + 8, :] += np.float32(0.55)
 
     def make(n, rng):
         labels = rng.integers(0, num_classes, size=n)
-        x = templates[labels] + rng.normal(0, noise, size=(n, h, w, c))
+        x = templates[labels]
+        x += rng.normal(0, noise, size=(n, h, w, c)).astype(np.float32)
         x = np.clip(x, 0, 1)
         # quantize like uint8-sourced data
         x = np.round(x * 255).astype(np.uint8).astype(np.float32) / 255.0

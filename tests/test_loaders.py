@@ -95,3 +95,29 @@ def test_ood_mix_is_seeded_and_complete(data_dir):
     assert sorted(ood_x.ravel().tolist()) == sorted(
         x_test.ravel().tolist() + (x_test + 100).ravel().tolist()
     )
+
+
+def test_synth_paper_scale_knob(data_dir, monkeypatch):
+    """TIP_SYNTH_SCALE=paper inflates synthetic stand-ins to the reference's
+    real dataset scale (60k/10k), so wall-clock studies on synthetic data
+    (scripts/capture_tpu_evidence.py) measure full-study shapes."""
+    from simple_tip_tpu.data import loaders
+
+    monkeypatch.setenv("TIP_SYNTH_SCALE", "paper")
+    requested = {}
+    real = loaders.synthetic.image_classification
+
+    def spy(*, seed, n_train, n_test, shape, **kw):
+        requested["sizes"] = (n_train, n_test)
+        return real(seed=seed, n_train=64, n_test=16, shape=shape, **kw)
+
+    monkeypatch.setattr(loaders.synthetic, "image_classification", spy)
+    loaders.load_mnist.cache_clear()
+    loaders.load_mnist()
+    loaders.load_mnist.cache_clear()
+    assert requested["sizes"] == (60000, 10000)
+
+    monkeypatch.delenv("TIP_SYNTH_SCALE")
+    loaders.load_mnist()
+    loaders.load_mnist.cache_clear()
+    assert requested["sizes"] == (12000, 2000)
