@@ -139,9 +139,38 @@ def check_model_checkpoints(case_study: str, runs: range) -> List[int]:
     return [r for r in runs if f"{r}.msgpack" not in existing]
 
 
+def data_source(case_study: str) -> str:
+    """Human-readable data-source verdict for the check phase.
+
+    Paper-comparable runs require REAL (RUNBOOK.md section 2 gate); a
+    SYNTHETIC verdict means results are structurally valid only. Presence
+    semantics come from the loaders themselves (loaders.dataset_presence),
+    so this report cannot drift from what load_* actually does."""
+    from simple_tip_tpu.data.loaders import dataset_presence
+
+    state = dataset_presence(case_study)
+    if case_study == "imdb":
+        return {
+            "real": "REAL (tokenized caches)",
+        }.get(state, "SYNTHETIC stand-in (mount imdb/*.npy or imdb/raw/*.jsonl + onramp)")
+    return {
+        "real": "REAL (nominal + corruption cache)",
+        "nominal-only": (
+            "REAL nominal; corruption cache will be GENERATED "
+            "(not the *-C benchmark)"
+        ),
+        "incomplete-cache": (
+            f"BROKEN corruption cache (exactly one of {case_study}_c_images/"
+            f"_c_labels present) — the loader refuses to overwrite it and "
+            f"uses a generated set in-memory; fix or remove the stray file"
+        ),
+    }.get(state, f"SYNTHETIC stand-in (mount {case_study}.npz)")
+
+
 def report(case_study: str, num_runs: int = 100, has_dropout: bool = True) -> str:
     """Human-readable completeness report for one case study."""
     lines = [f"artifact check: {case_study} (runs 0..{num_runs - 1})"]
+    lines.append(f"  data: {data_source(case_study)}")
     missing_models = check_model_checkpoints(case_study, range(num_runs))
     lines.append(
         f"  models: {num_runs - len(missing_models)}/{num_runs} trained"

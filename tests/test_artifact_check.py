@@ -70,3 +70,31 @@ def test_times_artifacts_audit(tmp_path, monkeypatch):
     (times / "mnist_ood_0_dsa").unlink()
     assert check_times_artifacts("mnist", range(1), True) == {0: 1}
     assert check_times_artifacts("mnist", range(2), True)[1] == 44
+
+
+def test_data_source_verdicts(tmp_path, monkeypatch):
+    import numpy as np
+
+    from simple_tip_tpu.utils.artifact_check import data_source
+
+    monkeypatch.setenv("TIP_DATA_DIR", str(tmp_path))
+    assert "SYNTHETIC" in data_source("mnist")
+    assert "SYNTHETIC" in data_source("imdb")
+
+    np.savez(tmp_path / "mnist.npz", x_train=np.zeros((2, 4, 4)))
+    assert data_source("mnist").startswith("REAL nominal; corruption cache")
+    np.save(tmp_path / "mnist_c_images.npy", np.zeros((2, 4, 4)))
+    np.save(tmp_path / "mnist_c_labels.npy", np.zeros(2))
+    assert data_source("mnist") == "REAL (nominal + corruption cache)"
+
+
+def test_data_source_incomplete_cache(tmp_path, monkeypatch):
+    import numpy as np
+
+    from simple_tip_tpu.utils.artifact_check import data_source
+
+    monkeypatch.setenv("TIP_DATA_DIR", str(tmp_path))
+    np.savez(tmp_path / "mnist.npz", x_train=np.zeros((2, 4, 4)))
+    np.save(tmp_path / "mnist_c_images.npy", np.zeros((2, 4, 4)))
+    # labels missing -> the loader refuses to cache; the verdict must say so
+    assert "BROKEN" in data_source("mnist")
