@@ -26,6 +26,7 @@ def harness():
 
 
 def test_study_resumes_and_skips_ok_runs(harness, tmp_path, monkeypatch):
+    monkeypatch.setattr(harness, "REPO", str(tmp_path))  # probe log -> tmp
     monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "assets"))
     monkeypatch.setenv("TIP_DATA_DIR", str(tmp_path / "data"))
     monkeypatch.setenv("TIP_SYNTH_SCALE", "paper")
@@ -73,6 +74,7 @@ def test_study_resumes_and_skips_ok_runs(harness, tmp_path, monkeypatch):
 
 
 def test_study_stops_on_wedge_and_persists_partial(harness, tmp_path, monkeypatch):
+    monkeypatch.setattr(harness, "REPO", str(tmp_path))  # probe log -> tmp
     monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "assets"))
     monkeypatch.setenv("TIP_DATA_DIR", str(tmp_path / "data"))
     monkeypatch.setenv("TIP_SYNTH_SCALE", "paper")
