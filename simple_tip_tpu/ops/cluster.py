@@ -15,8 +15,11 @@ responsibilities are MXU matmuls — with sklearn-compatible APIs and defaults:
   random_state)``: EM with full covariances, k-means-initialized
   responsibilities, ``score_samples`` = mixture log-likelihood.
 
-``TIP_CLUSTER_BACKEND=sklearn`` switches the surprise-adequacy handlers back
-to sklearn (useful for cross-validation of results).
+Backend selection for the surprise-adequacy handlers is
+``TIP_CLUSTER_BACKEND``: ``auto`` (default — sklearn's early-stopping C
+implementations on CPU hosts, these jnp kernels on accelerator backends;
+measured 91x on the paper-scale pc-mlsa fit, HOST_PHASE.json), or ``jax`` /
+``sklearn`` to force one side. Unrecognized values raise.
 """
 
 import functools

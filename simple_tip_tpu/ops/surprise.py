@@ -44,12 +44,34 @@ logger = logging.getLogger(__name__)
 def _cluster_backend():
     """(KMeans, silhouette_score, GaussianMixture) from the configured backend.
 
-    Default: the TPU-native jnp implementations (ops/cluster.py). Set
-    ``TIP_CLUSTER_BACKEND=sklearn`` to cross-validate against sklearn's.
+    ``TIP_CLUSTER_BACKEND``: ``auto`` (default) picks sklearn's C
+    implementations on CPU hosts and the TPU-native jnp ones
+    (ops/cluster.py) when an accelerator backend is active; ``jax`` /
+    ``sklearn`` force one side. Rationale (measured, HOST_PHASE.json): the
+    jnp GMM's fixed-length vmapped EM restarts are built for the MXU —
+    on one CPU core they cost ~110 min of a 121-min paper-scale prio phase,
+    where sklearn's early-stopping C EM (what the reference itself runs,
+    reference: src/core/surprise.py:509) fits in minutes. Same policy as
+    the AL retrain path (device: vmapped ensemble; host: sequential).
     """
     import os
 
-    if os.environ.get("TIP_CLUSTER_BACKEND", "jax") == "sklearn":
+    choice = os.environ.get("TIP_CLUSTER_BACKEND", "auto").strip().lower()
+    if choice not in ("auto", "jax", "sklearn"):
+        raise ValueError(
+            f"TIP_CLUSTER_BACKEND={choice!r} not recognized (auto, jax, sklearn)"
+        )
+    if choice == "auto":
+        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            choice = "sklearn"
+        else:
+            import jax
+
+            # By the time SA runs, the engine's forward passes have long
+            # initialized the backend, so this does not first-touch a
+            # potentially dead tunnel.
+            choice = "sklearn" if jax.default_backend() == "cpu" else "jax"
+    if choice == "sklearn":
         from sklearn.cluster import KMeans
         from sklearn.metrics import silhouette_score
         from sklearn.mixture import GaussianMixture

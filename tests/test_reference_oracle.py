@@ -452,15 +452,25 @@ def test_mlsa_agrees_with_reference_on_separated_blobs(ref):
     """MLSA is GMM-based (stochastic init on the reference side), so exact
     parity is not defined; on well-separated blobs both fits converge to the
     same mixture and the scores must be near-identical."""
+    import pytest as _pytest
+
     from simple_tip_tpu.ops.surprise import MLSA
 
+    # Pin OUR side to the jnp GMM: on a CPU test host the 'auto' default
+    # resolves to sklearn, which would make this oracle compare sklearn
+    # against sklearn and stop covering the kernel that runs on TPU.
+    mp = _pytest.MonkeyPatch()
+    mp.setenv("TIP_CLUSTER_BACKEND", "jax")
     rng = np.random.default_rng(10)
     blob_a = rng.normal(size=(100, 4)) * 0.3 + 10.0
     blob_b = rng.normal(size=(100, 4)) * 0.3 - 10.0
     train = [np.vstack([blob_a, blob_b])]
     test = [rng.normal(size=(40, 4)) * 0.3 + np.where(rng.random((40, 1)) < 0.5, 10, -10)]
     np.random.seed(0)  # the reference GMM draws from the numpy global RNG
-    ours = np.asarray(MLSA(train, num_components=2)(test), np.float64)
+    try:
+        ours = np.asarray(MLSA(train, num_components=2)(test), np.float64)
+    finally:
+        mp.undo()
     theirs = np.asarray(ref["surprise"].MLSA(train, num_components=2)(test), np.float64)
     from scipy.stats import spearmanr
 
