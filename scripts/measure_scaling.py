@@ -70,6 +70,19 @@ def main():
     from simple_tip_tpu.config import enable_compilation_cache
     from simple_tip_tpu.models.train import TrainConfig
     from simple_tip_tpu.parallel import train_ensemble
+    from simple_tip_tpu.utils.flops import (
+        conv_net_forward_flops,
+        mfu,
+        training_step_flops,
+        transformer_forward_flops,
+    )
+
+    fwd_flops = {
+        "mnist": conv_net_forward_flops("mnist"),
+        "fmnist": conv_net_forward_flops("fmnist"),
+        "cifar10": conv_net_forward_flops("cifar10"),
+        "imdb": transformer_forward_flops(),
+    }
 
     enable_compilation_cache()
     dev = jax.devices()[0]
@@ -110,9 +123,18 @@ def main():
             dt = time.perf_counter() - t0
             per_model = dt / g
             best = min(best, per_model) if best is not None else per_model
+            # Trained samples only: the epoch steps over the 90% head, so
+            # counting the held-out validation split would inflate MFU ~11%.
+            n_trained = len(x) - int(len(x) * cfg.validation_split)
+            rate = n_trained * g / dt
+            mfu_frac, _, _ = mfu(
+                rate * training_step_flops(fwd_flops[cs], 1),
+                dev.platform,
+                dev.device_kind,
+            )
             print(
                 f"{cs:8s} G={g:3d}: epoch {dt:6.2f}s  per-model {per_model:6.3f}s  "
-                f"({len(x) * g / dt:,.0f} samples/s)"
+                f"({rate:,.0f} samples/s, {mfu_frac * 100:.2f}% MFU)"
             )
         cs_hours = (
             RUNS * (epochs + RETRAINS_PER_RUN * epochs) * best / args.chips / 3600

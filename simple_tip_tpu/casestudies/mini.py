@@ -1,0 +1,75 @@
+"""Reduced-scale case studies for multi-run framework studies on small hosts.
+
+The real case studies (casestudies/base.py registry) carry the reference's
+paper hyperparameters — 15-20 epochs, 1000-sample AL selections, 60k
+datasets — which a single-core host cannot push through a 10-run x
+all-phases study in useful time. These minis keep every STRUCTURAL property
+the evaluation layer depends on (10 classes, dropout vs no-dropout model
+families, nominal + corrupted-OOD eval sets, the same tap layout and
+artifact contract) at ~1/40 the compute, so a full multi-run study —
+train → test_prio → active_learning → all four evaluations — runs
+end-to-end in minutes-per-run (scripts/mini_study.py, committed results
+under results/mini_study_r04/).
+
+Worker processes reconstruct these by name through the
+``TIP_CASE_STUDY_PROVIDER=simple_tip_tpu.casestudies.mini:provide`` hook
+(the same mechanism any user-defined case study uses).
+"""
+
+from typing import Optional
+
+import numpy as np
+
+from simple_tip_tpu.casestudies.base import CaseStudy, CaseStudySpec
+from simple_tip_tpu.data import synthetic
+from simple_tip_tpu.models import Cifar10ConvNet, MnistConvNet
+from simple_tip_tpu.models.train import TrainConfig
+
+N_TRAIN = 1200
+N_TEST = 400
+
+
+def _image_loader(shape, seed: int):
+    def loader():
+        (x_train, y_train), (x_test, y_test) = synthetic.image_classification(
+            seed=seed, n_train=N_TRAIN, n_test=N_TEST, shape=shape, num_classes=10
+        )
+        x_corr = synthetic.corrupt_images(x_test, seed=seed + 1, severity=0.6)
+        ood_x = np.concatenate([x_test, x_corr])
+        ood_y = np.concatenate([y_test, y_test])
+        perm = np.random.default_rng(0).permutation(len(ood_y))
+        return (x_train, y_train), (x_test, y_test), (ood_x[perm], ood_y[perm])
+
+    return loader
+
+
+MINI_CASE_STUDIES = {
+    "mini-mnist": CaseStudySpec(
+        name="mini-mnist",
+        model_factory=MnistConvNet,
+        loader=_image_loader((28, 28, 1), seed=41),
+        train_cfg=TrainConfig(batch_size=64, epochs=3, learning_rate=2e-3, validation_split=0.1),
+        nc_activation_layers=(0, 1, 2, 3),
+        sa_activation_layers=(3,),
+        prediction_badge_size=128,
+        num_classes=10,
+        al_num_selected=64,
+    ),
+    "mini-cifar10": CaseStudySpec(
+        name="mini-cifar10",
+        model_factory=Cifar10ConvNet,  # no dropout: VR intentionally absent
+        loader=_image_loader((32, 32, 3), seed=43),
+        train_cfg=TrainConfig(batch_size=64, epochs=3, learning_rate=2e-3, validation_split=0.1),
+        nc_activation_layers=(0, 1, 2, 3),
+        sa_activation_layers=(3,),
+        prediction_badge_size=128,
+        num_classes=10,
+        al_num_selected=64,
+    ),
+}
+
+
+def provide(name: str) -> Optional[CaseStudy]:
+    """TIP_CASE_STUDY_PROVIDER hook: resolve mini case studies by name."""
+    spec = MINI_CASE_STUDIES.get(name)
+    return CaseStudy(spec) if spec is not None else None

@@ -1,17 +1,28 @@
-"""Surprise-adequacy worker: fit the 5 tested SA variants on training ATs, then
-score + surprise-coverage-CAM every test set.
+"""Surprise-adequacy engine: fit the five tested SA variants on the training
+activation traces, score every test set, and derive surprise-coverage CAM
+prioritization orders.
 
-Behavioral contract matches the reference's ``SurpriseHandler``
-(reference: src/dnn_test_prio/handler_surprise.py:19-117): the TESTED_SA
-registry (dsa with 30% subsample, pc-lsa, pc-mdsa, pc-mlsa with 3 components,
-pc-mmdsa with KMeans k in 2..5 and 30% subsample), train ATs+predictions
-collected in ONE forward pass over sa_layers + output, SC profiles with 1000
-buckets upper-bounded by the max observed SA, and the per-variant
-``[setup, pred, quant, cam]`` time records.
+What is protocol (reproduced from the reference experiment,
+src/dnn_test_prio/handler_surprise.py:19-117, and pinned by
+tests/test_reference_engine_parity.py): the five-variant registry with its
+exact hyperparameters (DSA at 30% subsampling, per-class LSA/MDSA, per-class
+MLSA with 3 mixture components, KMeans-clustered MDSA with k ∈ 2..5 at 30%
+subsampling), train ATs + predictions collected in ONE forward pass over
+``sa_layers`` + the output layer, 1000-bucket surprise-coverage profiles,
+and the four-stage ``[setup, pred, quant, cam]`` time record where setup
+includes the (shared) train-AT collection time.
+
+What is this framework's own: the flow — each variant runs a
+fit → score → SC-CAM pipeline per dataset (the reference mutates its result
+dict across three separate passes), activations come from the jitted tap
+forward of ``BaseModel``, and the SC bucket upper bound is the maximum
+FINITE observed score: an LSA whose KDE degraded returns +inf for every
+sample, and bucket edges up to inf would be all-NaN, silently voiding the
+CAM (fix-with-note; non-finite scores simply land outside every bucket).
 """
 
 import logging
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -27,30 +38,47 @@ from simple_tip_tpu.ops.surprise import (
 )
 from simple_tip_tpu.ops.timer import Timer
 
+logger = logging.getLogger(__name__)
+
 NUM_SC_BUCKETS = 1000
 
-logger = logging.getLogger(__name__)
+# {sa_name: (train_ats, train_preds) -> scorer} — the tested registry.
+SA_VARIANTS: Dict[str, Callable] = {
+    "dsa": lambda ats, preds: DSA(ats, preds, subsampling=0.3),
+    "pc-lsa": lambda ats, preds: MultiModalSA.build_by_class(
+        ats, preds, lambda a, _: LSA(a)
+    ),
+    "pc-mdsa": lambda ats, preds: MultiModalSA.build_by_class(
+        ats, preds, lambda a, _: MDSA(a)
+    ),
+    "pc-mlsa": lambda ats, preds: MultiModalSA.build_by_class(
+        ats, preds, lambda a, _: MLSA(a, num_components=3)
+    ),
+    "pc-mmdsa": lambda ats, preds: MultiModalSA.build_with_kmeans(
+        ats, preds, lambda a, _: MDSA(a), potential_k=range(2, 6), subsampling=0.3
+    ),
+}
+
+DatasetResult = Tuple[np.ndarray, np.ndarray, List[float]]
+"""(sa_scores, sc_cam_order, [setup, pred, quant, cam] seconds)."""
+
+
+def _sc_cam_order(sa_scores: np.ndarray) -> np.ndarray:
+    """Coverage-additional order over 1000-bucket SC profiles, bounded by
+    the max finite score (see module docstring)."""
+    finite = np.asarray(sa_scores)[np.isfinite(sa_scores)]
+    upper = float(finite.max()) if finite.size else 1.0
+    profiles = SurpriseCoverageMapper(NUM_SC_BUCKETS, upper).get_coverage_profile(
+        sa_scores
+    )
+    return np.fromiter(cam(sa_scores, profiles), dtype=np.int64)
 
 
 class SurpriseHandler:
-    """Efficiently handles the tested surprise-adequacy instances."""
+    """One fitted-per-run surprise engine shared by the prio and AL phases."""
 
-    TESTED_SA = {
-        # Plain distance-based surprise adequacy
-        "dsa": lambda x, y: DSA(x, y, subsampling=0.3),
-        # Per-class likelihood surprise adequacy
-        "pc-lsa": lambda x, y: MultiModalSA.build_by_class(x, y, lambda x, y: LSA(x)),
-        # Per-class Mahalanobis-distance surprise adequacy
-        "pc-mdsa": lambda x, y: MultiModalSA.build_by_class(x, y, lambda x, y: MDSA(x)),
-        # Per-class multimodal likelihood surprise adequacy
-        "pc-mlsa": lambda x, y: MultiModalSA.build_by_class(
-            x, y, lambda x, y: MLSA(x, num_components=3)
-        ),
-        # Per-cluster (KMeans) Mahalanobis-distance surprise adequacy
-        "pc-mmdsa": lambda x, y: MultiModalSA.build_with_kmeans(
-            x, y, lambda x, y: MDSA(x), potential_k=range(2, 6), subsampling=0.3
-        ),
-    }
+    # Back-compat alias for the registry's historical name.
+    TESTED_SA = SA_VARIANTS
 
     def __init__(
         self,
@@ -70,72 +98,49 @@ class SurpriseHandler:
         )
         self.train_at_timer = Timer()
         with self.train_at_timer:
-            self.train_ats, self.train_pred = self._acti_and_pred(training_dataset)
+            self.train_ats, self.train_pred = self._traces(training_dataset)
 
-    def _acti_and_pred(
-        self, dataset: np.ndarray
-    ) -> Tuple[List[np.ndarray], np.ndarray]:
-        """Activations and predictions in a single forward pass."""
-        outputs = self.base_model.get_activations(dataset)
-        assert len(outputs) == len([i for i in self.sa_layers if isinstance(i, int)]) + 1
-        return outputs[:-1], np.argmax(outputs[-1], axis=1)
+    def _traces(self, dataset: np.ndarray) -> Tuple[List[np.ndarray], np.ndarray]:
+        """(tapped activations, argmax predictions) — one forward pass."""
+        outs = self.base_model.get_activations(dataset)
+        n_taps = sum(1 for layer in self.sa_layers if isinstance(layer, int))
+        assert len(outs) == n_taps + 1, (len(outs), n_taps)
+        return outs[:-1], np.argmax(outs[-1], axis=1)
 
     def evaluate_all(
         self,
         datasets: Dict[str, np.ndarray],
         dsa_badge_size: Optional[int] = None,
-    ) -> Dict[str, Dict[str, Tuple[np.ndarray, np.ndarray, List[float]]]]:
-        """SA scores + SC-CAM orders for every (variant, dataset) pair.
-
-        Returns ``{sa_name: {ds_name: (scores, cam_order, times)}}``.
-        """
-        res: Dict[str, Dict] = {}
-        test_apt = {}
-
-        logger.info("Collecting SA ATs")
+    ) -> Dict[str, Dict[str, DatasetResult]]:
+        """``{sa_name: {ds_name: (scores, cam_order, times)}}`` for every
+        (variant, dataset) pair."""
+        logger.info("collecting test-set activation traces")
+        traces: Dict[str, Tuple[List[np.ndarray], np.ndarray, float]] = {}
         for ds_name, dataset in datasets.items():
-            test_pred_timer = Timer()
-            with test_pred_timer:
-                test_ats, test_pred = self._acti_and_pred(dataset)
-            test_apt[ds_name] = (test_ats, test_pred, test_pred_timer.get())
+            with Timer() as pred_timer:
+                ats, preds = self._traces(dataset)
+            traces[ds_name] = (ats, preds, pred_timer.get())
 
-        for sa_name, sa_func in self.TESTED_SA.items():
-            res[sa_name] = {}
-            setup_timer = Timer()
-            with setup_timer:
-                logger.info("Creating %s instance", sa_name)
-                sa = sa_func(self.train_ats, self.train_pred)
-                if isinstance(sa, DSA) and dsa_badge_size is not None:
-                    sa.badge_size = dsa_badge_size
-            setup_time = self.train_at_timer.get() + setup_timer.get()
+        results: Dict[str, Dict[str, DatasetResult]] = {}
+        for sa_name, build in SA_VARIANTS.items():
+            logger.info("fitting %s", sa_name)
+            with Timer() as fit_timer:
+                scorer = build(self.train_ats, self.train_pred)
+                if dsa_badge_size is not None and isinstance(scorer, DSA):
+                    scorer.badge_size = dsa_badge_size
+            setup_s = self.train_at_timer.get() + fit_timer.get()
 
-            for ds_name, (test_ats, test_pred, test_pred_time) in test_apt.items():
-                sa_timer = Timer()
-                with sa_timer:
-                    logger.info("Calculating %s for %s", sa_name, ds_name)
-                    sa_pred = sa(test_ats, test_pred)
-                times = [setup_time, test_pred_time, sa_timer.get()]
-                res[sa_name][ds_name] = (sa_pred, times)
-
-        # CAM on surprise-coverage profiles
-        for sa_name in self.TESTED_SA.keys():
-            for ds_name in datasets.keys():
-                sa_pred, times = res[sa_name][ds_name]
-                cam_timer = Timer()
-                with cam_timer:
-                    # Upper bound chosen dynamically from the observed max —
-                    # the FINITE max: LSA yields +inf for all samples when the
-                    # KDE degrades to zero densities (ops/kde.py "failing
-                    # silently" mode), and linspace(0, inf) would produce
-                    # all-NaN bucket thresholds. Non-finite SA values then
-                    # simply fall outside every bucket.
-                    finite = np.asarray(sa_pred)[np.isfinite(sa_pred)]
-                    upper = float(finite.max()) if finite.size else 1.0
-                    coverage_mapper = SurpriseCoverageMapper(NUM_SC_BUCKETS, upper)
-                    coverage_profiles = coverage_mapper.get_coverage_profile(sa_pred)
-                    cam_order = [i for i in cam(sa_pred, coverage_profiles)]
-                cam_order = np.array(cam_order)
-                times.append(cam_timer.get())
-                res[sa_name][ds_name] = (sa_pred, cam_order, times)
-
-        return res
+            per_ds: Dict[str, DatasetResult] = {}
+            for ds_name, (ats, preds, pred_s) in traces.items():
+                logger.info("scoring %s on %s", sa_name, ds_name)
+                with Timer() as quant_timer:
+                    scores = scorer(ats, preds)
+                with Timer() as cam_timer:
+                    order = _sc_cam_order(scores)
+                per_ds[ds_name] = (
+                    scores,
+                    order,
+                    [setup_s, pred_s, quant_timer.get(), cam_timer.get()],
+                )
+            results[sa_name] = per_ds
+        return results

@@ -146,15 +146,26 @@ def al_retrain_ensemble(
         ).astype(np.int32)
         member_perm = jnp.asarray(perms)
 
+        # RNG derivation IDENTICAL to the sequential Trainer.train path
+        # (models/train.py): PRNGKey(seed) -> (init_rng, epoch_rng), then a
+        # per-epoch split chain. With member_perm already matching the
+        # sequential shuffle-then-head-split, every member of this ensemble
+        # computes the SAME training trajectory the sequential path would —
+        # batch==sequential equivalence is a tested invariant
+        # (tests/test_al_ensemble.py), not a hope.
         def one_init(seed):
-            return init_params(model, jax.random.PRNGKey(seed), shared_x[:1])
+            init_rng = jax.random.split(jax.random.PRNGKey(seed))[0]
+            return init_params(model, init_rng, shared_x[:1])
 
         params = jax.vmap(one_init)(jnp.asarray(seeds, dtype=jnp.uint32))
         opt_state = jax.vmap(tx.init)(params)
-        rngs = jnp.stack([jax.random.PRNGKey(int(s) + 20_000) for s in seeds])
+        epoch_rngs = jnp.stack(
+            [jax.random.split(jax.random.PRNGKey(int(s)))[1] for s in seeds]
+        )
 
         for epoch in range(cfg.epochs):
-            this_rngs = jax.vmap(lambda r: jax.random.fold_in(r, epoch))(rngs)
+            both = jax.vmap(jax.random.split)(epoch_rngs)
+            epoch_rngs, this_rngs = both[:, 0], both[:, 1]
             params, opt_state, losses = epoch_vmapped(
                 params,
                 opt_state,

@@ -1,101 +1,120 @@
-"""Wilcoxon p-value + Vargha-Delaney A12 effect-size statistics and dual
-heatmap plots (paper Figs 3/4).
+"""Pairwise Wilcoxon / Vargha-Delaney statistics and the dual-triangle
+heatmap figures (paper Figs 3/4).
 
-Reference: src/plotters/correlation_plot.py. The reference uses pingouin for
-the Wilcoxon test; here it is scipy.stats.wilcoxon (identical two-sided
-p-values). Bonferroni correction multiplies by C(num_approaches, 2).
+Artifact + figure contract (what the published outputs pin down): grids are
+[approach x approach] with only the upper triangle tested; untested cells
+hold the sentinels the CSV writers blank out (10000 for p, -10000 for
+effect, -1000 for n); the figure shows effect sizes (inferno) above the
+diagonal and Bonferroni-corrected p-values (viridis, log scale, capped at
+0.1) below it, with white separators between the three approach families.
+The reference computes its p-values with pingouin
+(src/plotters/correlation_plot.py uses ``pg.wilcoxon``); here
+``scipy.stats.wilcoxon`` produces the identical two-sided p
+(tests/test_plotters.py checks them equal), and the all-tied pair that
+makes the test undefined is NaN-guarded before scipy ever sees it.
 """
 
+from itertools import combinations
 from math import comb
-from typing import Dict, List, Union
+from typing import Dict, Hashable, List, Sequence, Tuple
 
 import numpy as np
 from scipy import stats
 
 from simple_tip_tpu.config import subdir
-from simple_tip_tpu.plotters.utils import human_approach_names
 
-SAMPLE_KEY = Union[int, str]
-APPROACH_KEY = Union[int, str]
+# Sentinels for never-tested grid cells — blanked by the CSV writers, so
+# they are part of the results-artifact contract.
+P_UNTESTED = 10_000.0
+E_UNTESTED = -10_000.0
+N_UNTESTED = -1_000
 
 
 def paired_vargha_delaney_a12(x: List[float], y: List[float], paired: bool = True) -> float:
-    """Scaled paired A12 effect size: 2*|A12 - 0.5|
-    (reference: correlation_plot.py:22-32)."""
+    """Scaled paired A12 effect size: ``2 * |A12 - 0.5|`` ∈ [0, 1]."""
     assert len(x) == len(y)
     x, y = np.array(x), np.array(y)
     if not paired:
         y = np.expand_dims(y, axis=1)
-    same = np.sum(x == y)
-    bigger = np.sum(x > y)
-    a12 = (bigger + 0.5 * same) / (x == y).size
-    return 2 * abs(a12 - 0.5)
+    wins = np.sum(x > y) + 0.5 * np.sum(x == y)
+    return 2 * abs(wins / (x == y).size - 0.5)
 
 
 def wilcoxon_p(x: List[float], y: List[float]) -> float:
-    """Two-sided Wilcoxon signed-rank p-value."""
-    x, y = np.asarray(x), np.asarray(y)
+    """Two-sided Wilcoxon signed-rank p-value (NaN when all diffs are 0)."""
     try:
-        return float(stats.wilcoxon(x, y, alternative="two-sided").pvalue)
+        return float(stats.wilcoxon(np.asarray(x), np.asarray(y), alternative="two-sided").pvalue)
     except ValueError:
-        # all-zero differences
         return np.nan
 
 
 class WilcoxonCorrelationPlot:
-    """Pairwise Wilcoxon/A12 grid over pooled per-run measurements."""
+    """Pairwise significance grid over pooled per-run measurements.
 
-    def __init__(self, approaches: List[str], num_tested_approaches: int):
-        self.p_value_calculator = wilcoxon_p
-        self.effect_size_calculator = paired_vargha_delaney_a12
-        self.error_correction = lambda p_values: p_values * comb(num_tested_approaches, 2)
+    Feed it ``(approach, sample_id, value)`` observations; it compares every
+    approach pair on their COMMON sample ids (a pair with disjoint runs is
+    NaN, not an error), Bonferroni-corrects against the full experiment's
+    C(num_tested_approaches, 2) comparisons, and renders/exports the grids.
+    """
+
+    def __init__(self, approaches: Sequence[str], num_tested_approaches: int):
         assert len(set(approaches)) == len(approaches), "Approach names must be unique"
-        self.approaches = approaches
-        self.measurements: Dict[APPROACH_KEY, Dict[SAMPLE_KEY, float]] = {
-            i: dict() for i in approaches
+        self.approaches = list(approaches)
+        self.bonferroni_factor = comb(num_tested_approaches, 2)
+        self._samples: Dict[str, Dict[Hashable, float]] = {
+            a: {} for a in self.approaches
         }
 
-    def add_measurement(self, approach, sample, value, unique: bool = True):
-        """Register an observation for statistical comparison."""
-        if approach not in self.approaches:
+    def add_measurement(self, approach, sample, value, unique: bool = True) -> None:
+        """Register one observation; approaches outside the grid are ignored
+        (callers iterate the full 39-approach pool even for subset grids)."""
+        pool = self._samples.get(approach)
+        if pool is None:
             return
-        if unique:
-            assert sample not in self.measurements[approach], (
-                f"Sample key name must be unique for a given array. "
-                f"Duplicate: {sample}. Pass `unique=False` to overwrite value."
+        if unique and sample in pool:
+            raise AssertionError(
+                f"Sample key name must be unique for a given array. Duplicate: "
+                f"{sample}. Pass `unique=False` to overwrite value."
             )
-        self.measurements[approach][sample] = value
+        pool[sample] = value
 
-    def calc_values(self):
-        """Compute the upper-triangle p-value / effect-size / n grids."""
-        grid_size = (len(self.approaches), len(self.approaches))
-        res = {
-            "p": np.full(grid_size, 10000, dtype=np.float64),
-            "e": np.full(grid_size, -10000, dtype=np.float64),
-            "num_samples": np.full(grid_size, -1000, dtype=np.int64),
+    @property
+    def measurements(self) -> Dict[str, Dict[Hashable, float]]:
+        return self._samples
+
+    def _paired(self, a: str, b: str) -> Tuple[List[float], List[float]]:
+        """Values of both approaches on their shared sample ids (sorted for
+        determinism — the reference iterates an unordered set)."""
+        pool_a, pool_b = self._samples[a], self._samples[b]
+        shared = sorted(pool_a.keys() & pool_b.keys())
+        return [pool_a[k] for k in shared], [pool_b[k] for k in shared]
+
+    def calc_values(self) -> Dict[str, np.ndarray]:
+        """Upper-triangle p / effect-size / sample-count grids."""
+        n = len(self.approaches)
+        grids = {
+            "p": np.full((n, n), P_UNTESTED, dtype=np.float64),
+            "e": np.full((n, n), E_UNTESTED, dtype=np.float64),
+            "num_samples": np.full((n, n), N_UNTESTED, dtype=np.int64),
         }
-        for i in range(len(self.approaches) - 1):
-            for j in range(i + 1, len(self.approaches)):
-                _, vals_i, vals_j = self._common(i, j)
-                res["num_samples"][i, j] = len(vals_i)
-                if len(vals_i) == 0 or vals_j == vals_i:
-                    res["p"][i, j] = np.nan
-                    res["e"][i, j] = np.nan
-                else:
-                    res["p"][i, j] = self.p_value_calculator(vals_i, vals_j)
-                    res["e"][i, j] = self.effect_size_calculator(vals_i, vals_j)
-        return res
+        for i, j in combinations(range(n), 2):
+            vals_i, vals_j = self._paired(self.approaches[i], self.approaches[j])
+            grids["num_samples"][i, j] = len(vals_i)
+            if not vals_i or vals_i == vals_j:
+                # no shared runs, or identical value lists (zero diffs make
+                # the signed-rank test undefined)
+                grids["p"][i, j] = grids["e"][i, j] = np.nan
+            else:
+                grids["p"][i, j] = wilcoxon_p(vals_i, vals_j)
+                grids["e"][i, j] = paired_vargha_delaney_a12(vals_i, vals_j)
+        return grids
 
-    def _common(self, i: int, j: int):
-        keys_1 = self.measurements[self.approaches[i]].keys()
-        keys_2 = set(self.measurements[self.approaches[j]].keys())
-        keys = sorted(set(keys_1).intersection(keys_2))
-        values_1 = [self.measurements[self.approaches[i]][k] for k in keys]
-        values_2 = [self.measurements[self.approaches[j]][k] for k in keys]
-        return keys, values_1, values_2
+    # -- figure --------------------------------------------------------------
 
-    def plot_heatmap(self, exp: str, cs: str, ds: str):
-        """Render the dual-triangle heatmap (effect sizes above, p-values below)."""
+    def plot_heatmap(self, exp: str, cs: str, ds: str) -> None:
+        """Render the dual-triangle heatmap to ``results/corr-...png``."""
+        import os
+
         import matplotlib
 
         matplotlib.use("Agg")
@@ -103,12 +122,13 @@ class WilcoxonCorrelationPlot:
         import seaborn as sns
         from matplotlib.colors import LogNorm
 
-        values = self.calc_values()
-        finite_p = values["p"][np.isfinite(values["p"]) & (values["p"] < 10000)]
-        if finite_p.size == 0 or (finite_p <= 0).all():
-            # Too little data for any valid p-value (e.g. a single-run smoke
-            # pipeline): LogNorm would reject its vmin/vmax. CSVs are already
-            # written by the callers; skip only the figure.
+        grids = self.calc_values()
+        tested_p = grids["p"][np.isfinite(grids["p"]) & (grids["p"] < P_UNTESTED)]
+        if tested_p.size == 0 or (tested_p <= 0).all():
+            # Too little data for any positive p-value (e.g. a single-run
+            # smoke pipeline): LogNorm would reject its vmin/vmax. The CSV
+            # grids are written by the callers regardless; only the figure
+            # is skipped, loudly.
             import warnings
 
             warnings.warn(
@@ -116,14 +136,13 @@ class WilcoxonCorrelationPlot:
                 "skipping heatmap figure"
             )
             return
-        matrix_0 = np.triu(values["e"].transpose())
-        error_corrected_p = self.error_correction(values["p"])
-        matrix_1 = np.tril(error_corrected_p)
 
-        ax_1 = sns.heatmap(
-            values["e"].transpose(),
+        # Upper triangle: effect sizes (transposed so [i, j] renders above
+        # the diagonal). Lower: Bonferroni-corrected p-values, log-scaled.
+        effect_ax = sns.heatmap(
+            grids["e"].transpose(),
             annot=False,
-            mask=matrix_0,
+            mask=np.triu(grids["e"].transpose()),
             cmap="inferno",
             square=True,
             cbar_kws=dict(
@@ -134,10 +153,10 @@ class WilcoxonCorrelationPlot:
                 label="Effect size",
             ),
         )
-        ax_2 = sns.heatmap(
-            values["p"],
+        p_ax = sns.heatmap(
+            grids["p"],
             annot=False,
-            mask=matrix_1,
+            mask=np.tril(grids["p"] * self.bonferroni_factor),
             cmap="viridis",
             vmax=0.1,
             square=True,
@@ -153,20 +172,60 @@ class WilcoxonCorrelationPlot:
             top=True,
             labeltop=True,
         )
-        human_labels = human_approach_names(self.approaches)
-        ax_2.set_xticks(
-            np.arange(len(self.approaches)) + 0.5, labels=human_labels, rotation=45, ha="left"
-        )
-        ax_2.set_yticks(np.arange(len(self.approaches)) + 0.5, labels=human_labels, rotation=0)
-        ax_1.hlines([3, 6], *ax_1.get_xlim(), color="white")
-        ax_1.vlines([3, 6], *ax_1.get_ylim(), color="white")
+        from simple_tip_tpu.plotters.utils import human_approach_names
+
+        labels = human_approach_names(self.approaches)
+        ticks = np.arange(len(self.approaches)) + 0.5
+        p_ax.set_xticks(ticks, labels=labels, rotation=45, ha="left")
+        p_ax.set_yticks(ticks, labels=labels, rotation=0)
+        # White separators between the three approach families; black
+        # diagonal dividing the two triangles.
+        effect_ax.hlines([3, 6], *effect_ax.get_xlim(), color="white")
+        effect_ax.vlines([3, 6], *effect_ax.get_ylim(), color="white")
         plt.axline((9, 9), (0, 0), linewidth=2, color="black")
 
-        import os
-
-        if cs != "all" or ds != "both":
-            out = os.path.join(subdir("results"), f"corr-{exp}-{cs}-{ds}.png")
-        else:
-            out = os.path.join(subdir("results"), f"corr-{exp}.png")
-        plt.savefig(out, bbox_inches="tight")
+        stem = f"corr-{exp}" if (cs, ds) == ("all", "both") else f"corr-{exp}-{cs}-{ds}"
+        plt.savefig(os.path.join(subdir("results"), f"{stem}.png"), bbox_inches="tight")
         plt.close()
+
+
+def pooled_statistics(
+    exp: str,
+    pooled: Dict[str, Dict[Hashable, float]],
+    subset_approaches: Sequence[str],
+    full_approaches: Sequence[str],
+    csv_prefix: str,
+    plot: bool = True,
+):
+    """Shared tail of both correlation evaluations: render the paper-subset
+    heatmap, compute the full-grid statistics, export them as
+    ``results/{csv_prefix}_{p,eff}.csv`` (sentinels blanked), and return the
+    two dataframes. (The reference duplicates this block across its two
+    eval_*_correlation modules.)"""
+    import os
+
+    import pandas as pd
+
+    from simple_tip_tpu.plotters.utils import human_approach_names
+
+    def _filled(approaches: Sequence[str]) -> "WilcoxonCorrelationPlot":
+        grid = WilcoxonCorrelationPlot(
+            approaches=list(approaches), num_tested_approaches=39
+        )
+        for approach, samples in pooled.items():
+            for sample, value in samples.items():
+                grid.add_measurement(approach, sample, value)
+        return grid
+
+    if plot:
+        _filled(subset_approaches).plot_heatmap(exp, "all", "both")
+
+    grids = _filled(full_approaches).calc_values()
+    labels = human_approach_names(list(full_approaches))
+    frames = []
+    for key, sentinel, suffix in (("p", P_UNTESTED, "p"), ("e", E_UNTESTED, "eff")):
+        frame = pd.DataFrame(data=grids[key], index=labels, columns=labels)
+        frame = frame.replace(sentinel, "")
+        frame.to_csv(os.path.join(subdir("results"), f"{csv_prefix}_{suffix}.csv"))
+        frames.append(frame)
+    return tuple(frames)

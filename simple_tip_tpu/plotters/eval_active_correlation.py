@@ -1,79 +1,62 @@
-"""Active-learning statistics (paper Fig 4): pooled Wilcoxon p-values and A12
-effect sizes over the (dataset, future)-split accuracies, emitting the heatmap
-and ``results/active_correlation_{p,eff}.csv``
-(reference: src/plotters/eval_active_correlation.py).
-"""
+"""Active-learning statistics (paper Fig 4): pooled Wilcoxon p-values and
+A12 effect sizes over the (dataset, future)-split AL accuracies, emitting
+the heatmap and ``results/active_correlation_{p,eff}.csv`` (artifact
+contract: src/plotters/eval_active_correlation.py)."""
 
-import os
-from typing import Dict, List
+from typing import Dict
 
-import pandas as pd
-
-from simple_tip_tpu.config import subdir
 from simple_tip_tpu.plotters import utils
-from simple_tip_tpu.plotters.correlation_plot import WilcoxonCorrelationPlot
-from simple_tip_tpu.plotters.eval_active_learning_table import load_arrays_active_learning
+from simple_tip_tpu.plotters.correlation_plot import pooled_statistics
+from simple_tip_tpu.plotters.eval_active_learning_table import (
+    load_arrays_active_learning,
+)
 from simple_tip_tpu.plotters.utils import identify_incomplete_values, named_tuples
 
-
-def _load(case_study: str, dataset: str) -> Dict[str, Dict[int, float]]:
-    res: Dict[str, Dict[int, float]] = {approach: dict() for approach in utils.APPROACHES}
-    res["original"] = dict()
-    res["random"] = dict()
-    loaded = load_arrays_active_learning(case_study, dataset, by_id=True)
-    for i in range(100):
-        for approach in loaded:
-            if i in loaded[approach]:
-                # Significance is checked on the (dataset, future) split only.
-                split_key = (dataset, "future")
-                res[approach][i] = loaded[approach][i][split_key]
-    return res
+_EXTENDED = [*utils.APPROACHES, "original", "random"]
 
 
-def _print_missing_values(cs, ds, values):
+def _future_split_accuracies(case_study: str, dataset: str) -> Dict[str, Dict[int, float]]:
+    """Per-(approach, run) accuracy on the (dataset, future) split — the
+    only split the significance analysis considers."""
+    raw = load_arrays_active_learning(case_study, dataset, by_id=True)
+    return {
+        approach: {
+            run: accs[(dataset, "future")]
+            for run, accs in raw.get(approach, {}).items()
+            if run < utils.NUM_RUNS
+        }
+        for approach in _EXTENDED
+    }
+
+
+def _warn_missing(cs: str, ds: str, values) -> None:
     missing = identify_incomplete_values(values, has_dropout=cs != "cifar10")
-    if len(missing) > 0:
+    if missing:
         print(f"Missing values {cs} - {ds}: {missing}")
 
 
 def run(case_studies=("mnist", "fmnist", "cifar10", "imdb"), plot: bool = True):
-    """Pool AL accuracies, plot the 9-approach heatmap, emit the full CSVs."""
-    vals: List[Dict[str, Dict[str, float]]] = []
+    """Pool future-split AL accuracies over every (case study, dataset),
+    then delegate to the shared heatmap/CSV tail."""
+    pooled: Dict[str, Dict[str, float]] = {a: {} for a in _EXTENDED}
     for cs in case_studies:
-        for ds in ["nominal", "ood"]:
-            values = _load(cs, ds)
-            _print_missing_values(cs, ds, values)
-            approaches = utils.APPROACHES.copy()
-            approaches.extend(["original", "random"])
-            vals.append(named_tuples(cs, values, None, approaches=approaches))
+        for ds in ("nominal", "ood"):
+            values = _future_split_accuracies(cs, ds)
+            _warn_missing(cs, ds, values)
+            named = named_tuples(cs, values, None, _EXTENDED)
+            for approach, samples in named.items():
+                # Reference pooling semantics: ood replaces nominal for the
+                # shared {cs}_{run} sample ids (see eval_apfd_correlation).
+                pooled[approach].update(samples)
 
-    all_by_approach: Dict[str, Dict[str, float]] = dict()
-    for named in vals:
-        for approach, data in named.items():
-            all_by_approach.setdefault(approach, dict()).update(data)
-
-    if plot:
-        heat = WilcoxonCorrelationPlot(
-            approaches=utils.CORRELATION_PLOT_APPROACHES, num_tested_approaches=39
-        )
-        for approach, data in all_by_approach.items():
-            for measurement, value in data.items():
-                heat.add_measurement(approach, measurement, value)
-        heat.plot_heatmap("active", "all", "both")
-
-    full = WilcoxonCorrelationPlot(approaches=utils.APPROACHES, num_tested_approaches=39)
-    for approach, data in all_by_approach.items():
-        for measurement, value in data.items():
-            full.add_measurement(approach, measurement, value)
-    p_and_eff = full.calc_values()
-    human = utils.human_approach_names(utils.APPROACHES)
-    p_pd = pd.DataFrame(data=p_and_eff["p"], index=human, columns=human)
-    p_pd = p_pd.replace(10000, "")
-    p_pd.to_csv(os.path.join(subdir("results"), "active_correlation_p.csv"))
-    e_pd = pd.DataFrame(data=p_and_eff["e"], index=human, columns=human)
-    e_pd = e_pd.replace(-10000, "")
-    e_pd.to_csv(os.path.join(subdir("results"), "active_correlation_eff.csv"))
-    return p_pd, e_pd
+    return pooled_statistics(
+        "active",
+        pooled,
+        subset_approaches=utils.CORRELATION_PLOT_APPROACHES,
+        full_approaches=utils.APPROACHES,
+        csv_prefix="active_correlation",
+        plot=plot,
+    )
 
 
 if __name__ == "__main__":

@@ -1,9 +1,14 @@
 """Active-learning results table (paper Table 2).
 
-Loads the AL pickles by regex, averages accuracies per approach over runs,
-reports deltas vs. the ``random`` selection baseline, and emits
-``results/active.csv`` + a latex table
-(reference: src/plotters/eval_active_learning_table.py).
+Consumes the ``active_learning/`` pickle bus
+(``{cs}_{run}_{approach}_{observed-split}.pickle`` holding the four-split
+accuracy dict), averages each approach over its runs, reports gains
+relative to the ``random``-selection baseline (absolute accuracies for the
+``original`` model and the baseline itself), and emits
+``results/active.csv`` + the paper-subset latex table. The artifact regex
+and table layout are the reference contract
+(src/plotters/eval_active_learning_table.py); a missing VR on cifar10 is
+expected (no dropout) and not warned about.
 """
 
 import os
@@ -18,7 +23,7 @@ from simple_tip_tpu.plotters.utils import (
     APPROACHES,
     PAPER_APPROACHES,
     _row,
-    human_appraoch_name,
+    human_approach_name,
     load_all_for_regex,
     vertical_categories,
 )
@@ -26,65 +31,58 @@ from simple_tip_tpu.plotters.utils import (
 BASELINE = "random"
 RANDOM = "random"
 
+_SPLITS = ("nominal:observed", "nominal:future", "ood:observed", "ood:future")
+
+
+def _load_approach(case_study: str, approach: str, ds_name: str):
+    """(accuracy dicts, run ids) of one approach's AL pickles."""
+    pattern = re.compile(
+        f"{re.escape(case_study)}_\\d*_{re.escape(approach)}_{ds_name}\\."
+    )
+    values, names = load_all_for_regex("active_learning", pattern)
+    return values, [int(name.split("_")[1]) for name in names]
+
 
 def load_arrays_active_learning(
     case_study: str, ds_name: str, by_id: bool = False
 ) -> Dict[str, List[Dict[Tuple[str, str], float]]]:
-    """Per-run raw AL results for one case study and active split."""
-    res = dict()
-    incl_random = APPROACHES.copy()
-    incl_random.append(RANDOM)
-    for approach in incl_random:
-        regex = re.compile(f"{re.escape(case_study)}_\\d*_{re.escape(approach)}_{ds_name}\\.")
-        vals, files = load_all_for_regex("active_learning", regex)
-        if not by_id:
-            res[approach] = vals
-        else:
-            res[approach] = {int(files[i].split("_")[1]): vals[i] for i in range(len(vals))}
-
-    original_regex = re.compile(f"{re.escape(case_study)}_\\d*_original_na\\.")
-    original_vals, original_files = load_all_for_regex("active_learning", original_regex)
-    if not by_id:
-        res["original"] = original_vals
-    else:
-        res["original"] = {
-            int(original_files[i].split("_")[1]): original_vals[i]
-            for i in range(len(original_vals))
-        }
+    """Raw per-run AL results for one (case study, observed split), per
+    approach — including the ``random`` baseline and the untouched
+    ``original`` model (whose artifact carries split 'na')."""
+    wanted = [*APPROACHES, RANDOM, ("original", "na")]
+    res = {}
+    for entry in wanted:
+        approach, split = entry if isinstance(entry, tuple) else (entry, ds_name)
+        values, run_ids = _load_approach(case_study, approach, split)
+        res[approach] = dict(zip(run_ids, values)) if by_id else values
     return res
 
 
 def _reduce_active_learning(cs, active_learning_files):
-    """Average each approach's per-split accuracies over runs."""
-    res = dict()
-    for approach, run_results in active_learning_files.items():
-        if len(run_results) == 0:
-            if not (approach == "VR" and cs == "cifar10"):
+    """Run-average each approach's per-split accuracies."""
+    reduced = {}
+    for approach, runs in active_learning_files.items():
+        if not runs:
+            if approach != "VR" or cs != "cifar10":
                 warnings.warn(f"missing AL results for {approach} on {cs}")
             continue
-        assert all(
-            run_results[0].keys() == run_results[i].keys()
-            for i in range(1, len(run_results))
-        )
-        res[approach] = {
-            key: sum(r[key] for r in run_results) / len(run_results)
-            for key in run_results[0].keys()
+        splits = runs[0].keys()
+        assert all(r.keys() == splits for r in runs[1:]), approach
+        reduced[approach] = {
+            split: sum(r[split] for r in runs) / len(runs) for split in splits
         }
-    return res
+    return reduced
 
 
 def _relative_active_learning_gains(reduced, baseline: str):
-    """Per-approach accuracy minus the baseline selection's accuracy."""
-    assert baseline in ["random", "original"]
-    assert baseline in reduced.keys()
-    res = dict()
-    for approach, performance in reduced.items():
-        if approach == baseline:
-            continue
-        res[approach] = {
-            key: performance[key] - reduced[baseline][key] for key in performance.keys()
-        }
-    return res
+    """Accuracy delta vs the baseline selection, per approach and split."""
+    assert baseline in ("random", "original") and baseline in reduced
+    base = reduced[baseline]
+    return {
+        approach: {split: acc - base[split] for split, acc in performance.items()}
+        for approach, performance in reduced.items()
+        if approach != baseline
+    }
 
 
 def _forma(x):
@@ -92,52 +90,46 @@ def _forma(x):
 
 
 def build_data_frame(case_studies: List[str]) -> pd.DataFrame:
-    """Assemble the full AL results dataframe."""
+    """Assemble the full AL results dataframe ('n.a.' for missing cells)."""
     col_idx = pd.MultiIndex.from_product(
-        [
-            case_studies,
-            ["nominal", "ood"],
-            ["nominal:observed", "nominal:future", "ood:observed", "ood:future"],
-        ]
+        [case_studies, ["nominal", "ood"], list(_SPLITS)]
     )
-    rows = ["original", "random"]
-    rows.extend(APPROACHES)
-    category_and_rows = [_row(row) for row in rows]
-    row_index = pd.MultiIndex.from_tuples(category_and_rows, names=["category", "approach"])
+    rows = ["original", "random", *APPROACHES]
+    row_index = pd.MultiIndex.from_tuples(
+        [_row(r) for r in rows], names=["category", "approach"]
+    )
     df = pd.DataFrame(columns=col_idx, index=row_index)
 
     for cs in case_studies:
-        for obs in ["nominal", "ood"]:
-            file_values = load_arrays_active_learning(cs, obs)
-            reduced = _reduce_active_learning(cs, file_values)
+        for obs in ("nominal", "ood"):
+            raw = load_arrays_active_learning(cs, obs)
+            reduced = _reduce_active_learning(cs, raw)
             if BASELINE not in reduced:
                 continue
-            relative = _relative_active_learning_gains(reduced, BASELINE)
-            for approach in ["original", "random"]:
-                if approach not in reduced:
-                    continue
-                for key in reduced[approach].keys():
-                    df.at[_row(approach), (cs, obs, f"{key[0]}:{key[1]}")] = _forma(
-                        reduced[approach][key]
-                    )
+            gains = _relative_active_learning_gains(reduced, BASELINE)
+            # Absolute accuracies for the two baselines, deltas for the rest.
+            for approach in ("original", "random"):
+                for split, acc in reduced.get(approach, {}).items():
+                    col = (cs, obs, f"{split[0]}:{split[1]}")
+                    df.at[_row(approach), col] = _forma(acc)
             for approach in APPROACHES:
-                try:
-                    for key in relative[approach].keys():
-                        df.at[_row(approach), (cs, obs, f"{key[0]}:{key[1]}")] = _forma(
-                            relative[approach][key]
-                        )
-                except KeyError:
-                    for split in ["nominal:observed", "nominal:future", "ood:observed", "ood:future"]:
+                per_split = gains.get(approach)
+                if per_split is None:
+                    for split in _SPLITS:
                         df.at[_row(approach), (cs, obs, split)] = "n.a."
+                else:
+                    for split, delta in per_split.items():
+                        col = (cs, obs, f"{split[0]}:{split[1]}")
+                        df.at[_row(approach), col] = _forma(delta)
     return df
 
 
-def latex_table(pd_df: pd.DataFrame):
-    """Emit the paper-subset latex table."""
-    paper_approaches = PAPER_APPROACHES.copy()
-    paper_approaches.extend(["original", "random"])
-    pd_df = pd_df.iloc[pd_df.index.get_level_values("approach").isin(paper_approaches)]
-    pd_df = pd_df.rename(mapper=human_appraoch_name, axis="index")
+def latex_table(pd_df: pd.DataFrame) -> None:
+    """Emit the paper-subset latex table (the future-split columns whose
+    active split matches the evaluated dataset)."""
+    keep = [*PAPER_APPROACHES, "original", "random"]
+    pd_df = pd_df.iloc[pd_df.index.get_level_values("approach").isin(keep)]
+    pd_df = pd_df.rename(mapper=human_approach_name, axis="index")
     paper_columns = [
         c for c in pd_df.columns if c[2].startswith(c[1]) and c[2].endswith("future")
     ]
@@ -151,8 +143,7 @@ def latex_table(pd_df: pd.DataFrame):
     except Exception as e:
         warnings.warn(f"latex table rendering failed: {e}")
         return
-    latex = vertical_categories(latex)
-    latex = latex.replace("category", "", 1)
+    latex = vertical_categories(latex).replace("category", "", 1)
     with open(os.path.join(subdir("results"), "active_paper_table.tex"), "w") as f:
         f.write(latex)
 

@@ -1,63 +1,46 @@
 """APFD statistics (paper Fig 3): pooled Wilcoxon p-values and A12 effect
-sizes across all (case study x dataset) APFD values, emitting the heatmap and
-``results/apfd_correlation_{p,eff}.csv``
-(reference: src/plotters/eval_apfd_correlation.py).
-"""
+sizes across all (case study x dataset) APFD values, emitting the heatmap
+and ``results/apfd_correlation_{p,eff}.csv`` (artifact contract:
+src/plotters/eval_apfd_correlation.py)."""
 
-import os
-from typing import Dict, List
+from typing import Dict
 
-import pandas as pd
-
-from simple_tip_tpu.config import subdir
 from simple_tip_tpu.plotters import utils
-from simple_tip_tpu.plotters.correlation_plot import WilcoxonCorrelationPlot
+from simple_tip_tpu.plotters.correlation_plot import pooled_statistics
 from simple_tip_tpu.plotters.eval_apfd_table import load_apfd_values
 from simple_tip_tpu.plotters.utils import identify_incomplete_values, named_tuples
 
 
-def _print_missing_values(cs, ds, values):
+def _warn_missing(cs: str, ds: str, values) -> None:
     missing = identify_incomplete_values(values, has_dropout=cs != "cifar10")
-    if len(missing) > 0:
+    if missing:
         print(f"Missing values {cs} - {ds}: {missing}")
 
 
 def run(case_studies=("mnist", "fmnist", "cifar10", "imdb"), plot: bool = True):
-    """Pool APFD values, plot the 9-approach heatmap, emit the full CSVs."""
-    vals: List[Dict[str, Dict[str, float]]] = []
+    """Pool APFD values over every (case study, dataset), then delegate to
+    the shared heatmap/CSV tail."""
+    pooled: Dict[str, Dict[str, float]] = {a: {} for a in utils.APPROACHES}
     for cs in case_studies:
-        for ds in ["nominal", "ood"]:
+        for ds in ("nominal", "ood"):
             values = load_apfd_values(cs, ds)
-            _print_missing_values(cs, ds, values)
-            vals.append(named_tuples(cs, values, None, utils.APPROACHES))
+            _warn_missing(cs, ds, values)
+            named = named_tuples(cs, values, None, utils.APPROACHES)
+            for approach, samples in named.items():
+                # dict.update, NOT uniqueness-checked insertion: sample ids
+                # are {cs}_{run}, so the ood pass intentionally replaces the
+                # nominal pass's value — the reference's pooling semantics
+                # (its run() merges per-(cs,ds) collections with .update()).
+                pooled[approach].update(samples)
 
-    all_by_approach: Dict[str, Dict[str, float]] = dict()
-    for named in vals:
-        for approach, data in named.items():
-            all_by_approach.setdefault(approach, dict()).update(data)
-
-    if plot:
-        heat = WilcoxonCorrelationPlot(
-            approaches=utils.CORRELATION_PLOT_APPROACHES, num_tested_approaches=39
-        )
-        for approach, data in all_by_approach.items():
-            for measurement, value in data.items():
-                heat.add_measurement(approach, measurement, value)
-        heat.plot_heatmap("apfd", "all", "both")
-
-    full = WilcoxonCorrelationPlot(approaches=utils.APPROACHES, num_tested_approaches=39)
-    for approach, data in all_by_approach.items():
-        for measurement, value in data.items():
-            full.add_measurement(approach, measurement, value)
-    p_and_eff = full.calc_values()
-    human = utils.human_approach_names(utils.APPROACHES)
-    p_pd = pd.DataFrame(data=p_and_eff["p"], index=human, columns=human)
-    p_pd = p_pd.replace(10000, "")
-    p_pd.to_csv(os.path.join(subdir("results"), "apfd_correlation_p.csv"))
-    e_pd = pd.DataFrame(data=p_and_eff["e"], index=human, columns=human)
-    e_pd = e_pd.replace(-10000, "")
-    e_pd.to_csv(os.path.join(subdir("results"), "apfd_correlation_eff.csv"))
-    return p_pd, e_pd
+    return pooled_statistics(
+        "apfd",
+        pooled,
+        subset_approaches=utils.CORRELATION_PLOT_APPROACHES,
+        full_approaches=utils.APPROACHES,
+        csv_prefix="apfd_correlation",
+        plot=plot,
+    )
 
 
 if __name__ == "__main__":

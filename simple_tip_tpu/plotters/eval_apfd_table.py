@@ -1,16 +1,22 @@
 """APFD performance table (paper Table 1).
 
-Walks ``priorities/``, parses the underscore-delimited artifact names, derives
-orders (scores -> descending argsort; cam orders used directly), computes APFD
-per (approach, run), averages over runs, adds the timing columns and emits
-``results/apfds.csv`` plus a latex table
-(reference: src/plotters/eval_apfd_table.py).
+Consumes the ``priorities/`` artifact bus — masks
+(``{cs}_{ds}_{run}_is_misclassified``), score arrays
+(``..._{approach}_scores``) and CAM orders (``..._{approach}_cam_order``) —
+derives a prioritization order per (approach, run) (descending score
+argsort; CAM orders verbatim), scores APFD, averages over the first 100
+runs, attaches the first-10-runs timing columns, and emits
+``results/apfds.csv`` + the paper-subset latex table. Artifact naming and
+table layout follow the reference contract
+(src/plotters/eval_apfd_table.py); the parsing and aggregation below are
+suffix-driven rather than the reference's token-count dispatch.
 """
 
 import os
 import warnings
-from statistics import mean
-from typing import Dict, List
+from collections import defaultdict
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import pandas as pd
@@ -22,8 +28,7 @@ from simple_tip_tpu.plotters.utils import (
     APPROACHES,
     PAPER_APPROACHES,
     _row,
-    approach_name,
-    human_appraoch_name,
+    human_approach_name,
     vertical_categories,
 )
 
@@ -31,79 +36,88 @@ TIME_COL = "time"
 
 FIRST_K_MODELS_CONSIDERED = 100
 
+_MASK_SUFFIX = "is_misclassified"
+_SCORE_SUFFIX = "_scores"
+_CAM_SUFFIX = "_cam_order"
+
+
+def _parse_artifact(stem: str) -> Optional[Tuple[str, Optional[str]]]:
+    """``{run}_{rest}`` -> (run_id, approach) — approach None for the mask.
+
+    The approach embedded in ``rest`` is already in canonical
+    ``{metric}[_{param}]`` form, so suffix stripping recovers it for every
+    family at once (NC with params, SA stems, uncertainty quantifiers).
+    """
+    run_id, _, rest = stem.partition("_")
+    if not run_id.isdigit():
+        return None
+    if rest == _MASK_SUFFIX:
+        return run_id, None
+    if rest.endswith(_CAM_SUFFIX):
+        return run_id, rest[: -len(_CAM_SUFFIX)] + "-cam"
+    if rest.endswith(_SCORE_SUFFIX):
+        return run_id, rest[: -len(_SCORE_SUFFIX)]
+    if rest.startswith("uncertainty_"):
+        return run_id, rest[len("uncertainty_"):]
+    return None
+
 
 def load_apfd_values(case_study: str, ds_name: str) -> Dict[str, Dict[int, float]]:
-    """APFD per (approach, run) for one case study and dataset."""
-    misclassifications = dict()
-    orders = dict()
-
-    for root, dirs, files in os.walk(os.path.join(output_folder(), "priorities")):
-        for file in files:
-            if not file.endswith(".npy"):
+    """``{approach: {run: apfd}}`` for one (case study, dataset)."""
+    folder = Path(output_folder()) / "priorities"
+    prefix = f"{case_study}_{ds_name}_"
+    masks: Dict[int, np.ndarray] = {}
+    orders: Dict[Tuple[str, int], np.ndarray] = {}
+    if folder.is_dir():
+        for path in sorted(folder.rglob("*.npy")):
+            if not path.name.startswith(prefix):
                 continue
-            if not file.startswith(f"{case_study}_{ds_name}"):
+            parsed = _parse_artifact(path.name[len(prefix):-len(".npy")])
+            if parsed is None:
                 continue
-            arr = np.load(os.path.join(root, file))
-            if file.endswith("is_misclassified.npy"):
-                _, _, model_id, _, _ = file.split("_")
-                if int(model_id) < FIRST_K_MODELS_CONSIDERED:
-                    misclassifications[model_id] = arr
-            elif file.endswith("cam_order.npy"):
-                if "dsa" in file or "lsa" in file:
-                    _, _, model_id, metric, _, _ = file.split("_")
-                    metric = approach_name(metric, cam=True)
-                else:
-                    _, _, model_id, metric, param, _, _ = file.split("_")
-                    metric = approach_name(metric, param=param, cam=True)
-                orders[(metric, model_id)] = arr
+            run_id, approach = parsed
+            run = int(run_id)
+            if run >= FIRST_K_MODELS_CONSIDERED:
+                continue
+            arr = np.load(path)
+            if approach is None:
+                masks[run] = arr
+            elif approach.endswith("-cam"):
+                orders[approach, run] = arr
             else:
-                # scores
-                if "uncertainty" in file:
-                    stem = file.replace(".npy", "").replace(f"{case_study}_{ds_name}_", "")
-                    model_id, metric = stem.split("_uncertainty_")
-                elif "dsa" in file or "lsa" in file:
-                    _, _, model_id, metric, _ = file.split("_")
-                else:
-                    _, _, model_id, metric, param, _ = file.split("_")
-                    metric = approach_name(metric, param=param, cam=False)
-                orders[(metric, model_id)] = np.argsort(-arr)
+                orders[approach, run] = np.argsort(-arr)
 
-    apfds: Dict[str, Dict[int, float]] = dict()
-    for i in range(FIRST_K_MODELS_CONSIDERED):
-        for approach in APPROACHES:
-            try:
-                order = orders[(approach, str(i))]
-                m = misclassifications[str(i)]
-            except KeyError:
-                continue
-            apfd = apfd_from_order(m, order)
-            apfds.setdefault(approach, dict())[i] = apfd
+    apfds: Dict[str, Dict[int, float]] = {}
+    for (approach, run), order in orders.items():
+        if approach not in APPROACHES or run not in masks:
+            continue
+        apfds.setdefault(approach, {})[run] = apfd_from_order(masks[run], order)
     return apfds
 
 
 def _get_as_df(case_studies: List[str]) -> pd.DataFrame:
+    """Run-averaged APFD per (approach, case study, dataset); 'n.a.' gaps."""
     col_idx = pd.MultiIndex.from_product([case_studies, ["nominal", "ood", TIME_COL]])
-    category_and_rows = [_row(row) for row in APPROACHES]
-    row_index = pd.MultiIndex.from_tuples(category_and_rows, names=["category", "approach"])
-    df = pd.DataFrame(columns=col_idx, index=row_index)
-
-    for case_study in case_studies:
-        for ds in ["nominal", "ood"]:
-            apfds = load_apfd_values(case_study, ds)
-            for category, approach in category_and_rows:
-                if approach in apfds and len(apfds[approach]) > 0:
-                    df.loc[(category, approach), (case_study, ds)] = np.mean(
-                        list(apfds[approach].values())
-                    )
-                else:
-                    df.loc[(category, approach), (case_study, ds)] = "n.a."
+    rows = [_row(a) for a in APPROACHES]
+    df = pd.DataFrame(
+        columns=col_idx,
+        index=pd.MultiIndex.from_tuples(rows, names=["category", "approach"]),
+    )
+    for cs in case_studies:
+        for ds in ("nominal", "ood"):
+            per_approach = load_apfd_values(cs, ds)
+            for row in rows:
+                runs = per_approach.get(row[1])
+                df.loc[row, (cs, ds)] = (
+                    float(np.mean(list(runs.values()))) if runs else "n.a."
+                )
     return df
 
 
-def _plot_latex_table(pd_df: pd.DataFrame):
-    """Emit the paper-subset latex table."""
+def _plot_latex_table(pd_df: pd.DataFrame) -> None:
+    """Emit the paper-subset latex table (rendering is non-essential)."""
     pd_df = pd_df.iloc[pd_df.index.get_level_values("approach").isin(PAPER_APPROACHES)]
-    pd_df = pd_df.rename(mapper=human_appraoch_name, axis="index")
+    pd_df = pd_df.rename(mapper=human_approach_name, axis="index")
     try:
         latex = pd_df.to_latex(
             multicolumn_format="c",
@@ -111,66 +125,51 @@ def _plot_latex_table(pd_df: pd.DataFrame):
             column_format="llcccccccccccc",
             float_format="{:.2%}".format,
         )
-    except Exception as e:  # latex rendering is non-essential
+    except Exception as e:
         warnings.warn(f"latex table rendering failed: {e}")
         return
-    latex = vertical_categories(latex)
-    latex = latex.replace("category", "", 1)
-    with open(os.path.join(subdir("results"), "apfd_paper_table.tex"), "w") as f:
-        f.write(latex)
+    latex = vertical_categories(latex).replace("category", "", 1)
+    Path(subdir("results"), "apfd_paper_table.tex").write_text(latex)
 
 
-def _add_reported_times(df: pd.DataFrame, partial_times: Dict):
-    """Fill the per-case-study time columns: total = setup + 2*(pred + quant)
-    (+ 2*cam for -cam rows), averaged over the first 10 runs."""
-    if not partial_times:
+# Reverse of times_collector's filename aliases.
+_METRIC_OF_ALIAS = {"SM": "softmax", "SE": "softmax_entropy", "PCS": "pcs", "DeepGini": "deep_gini"}
+
+
+def _add_reported_times(df: pd.DataFrame, times: Dict) -> None:
+    """Fill the time columns from the first-10-runs records.
+
+    Reported total = setup + 2*(pred + quant) — both datasets share one
+    setup — plus 2*cam for the -cam variant of scored approaches.
+    """
+    if not times:
         return
-    assert int(max(k[2] for k in partial_times.keys())) <= 9, "Should only consider first 10 runs"
+    assert all(
+        int(run) < times_collector.N_FIRST_MODELS_CONSIDERED
+        for _, _, run, _, _ in times
+    ), "Should only consider first 10 runs"
 
-    tips = set((k[3], k[4]) for k in partial_times.keys())
-    case_studies = set(k[0] for k in partial_times.keys())
-    for cs in case_studies:
-        for tc, tn in tips:
+    # Pool the per-(run, dataset) stage records of each (cs, metric, param).
+    pooled = defaultdict(list)
+    for (cs, _ds, _run, metric, param), record in times.items():
+        # Uncertainty quantifiers have no cam stage; pad to 4.
+        stages = (list(record) + [0.0] * 4)[:4]
+        pooled[cs, metric, param].append(stages)
 
-            def _match_k(k):
-                return k[0] == cs and k[3] == tc and k[4] == tn
-
-            matching = {k: v for k, v in partial_times.items() if _match_k(k)}
-            if not matching:
-                continue
-            # Pad time records to 4 entries (uncertainty metrics have no cam).
-            vals = [list(v) + [0.0] * (4 - len(v)) for v in matching.values()]
-            avg_setup = mean(v[0] for v in vals)
-            avg_pred = mean(v[1] for v in vals)
-            avg_quant = mean(v[2] for v in vals)
-            avg_cam = mean(v[3] for v in vals)
-
-            row = _times_naming_to_table_row(tc, tn)
-            if row[0] is None:
-                continue
-
-            def _format_time(t):
-                return f"{round(t)}s"
-
-            non_cam_time = avg_setup + 2 * (avg_pred + avg_quant)
-            if (cs, TIME_COL) in df.columns and row in df.index:
-                df.loc[row, (cs, TIME_COL)] = _format_time(non_cam_time)
-            if row[0] in ("surprise", "neuron coverage"):
-                cam_row = row[0], f"{row[1]}-cam"
-                if (cs, TIME_COL) in df.columns and cam_row in df.index:
-                    df.loc[cam_row, (cs, TIME_COL)] = _format_time(
-                        non_cam_time + 2 * avg_cam
-                    )
-
-
-def _times_naming_to_table_row(tip_type: str, param: str):
-    tip_type = "softmax" if tip_type == "SM" else tip_type
-    tip_type = "softmax_entropy" if tip_type == "SE" else tip_type
-    tip_type = "pcs" if tip_type == "PCS" else tip_type
-    tip_type = "deep_gini" if tip_type == "DeepGini" else tip_type
-    if param != "":
-        tip_type = f"{tip_type}_{param}"
-    return _row(tip_type)
+    for (cs, metric, param), records in pooled.items():
+        if (cs, TIME_COL) not in df.columns:
+            continue
+        setup_s, pred_s, quant_s, cam_s = np.mean(records, axis=0)
+        base = _METRIC_OF_ALIAS.get(metric, metric)
+        row = _row(base + (f"_{param}" if param else ""))
+        if row[0] is None:
+            continue
+        plain_s = setup_s + 2 * (pred_s + quant_s)
+        if row in df.index:
+            df.loc[row, (cs, TIME_COL)] = f"{round(plain_s)}s"
+        cam_row = (row[0], f"{row[1]}-cam")
+        if row[0] in ("surprise", "neuron coverage") and cam_row in df.index:
+            df.loc[cam_row, (cs, TIME_COL)] = f"{round(plain_s + 2 * cam_s)}s"
 
 
 def run(case_studies: List[str] = ("mnist", "fmnist", "cifar10", "imdb")):

@@ -1,35 +1,57 @@
-"""Loads the per-(cs, ds, model, metric) timing pickles from the bus
-(reference: src/plotters/times_collector.py): record = [setup, pred, quant,
-cam], first 10 models only."""
+"""Timing-artifact reader for the evaluation phase.
 
-import os
+The prioritization engine drops one pickle per (case study, dataset,
+model, approach) under ``<output>/times/``, holding the four-stage
+wall-clock record ``[setup, pred, quant, cam]`` (same bus layout as the
+reference, src/plotters/times_collector.py, which the times tables
+consume). Filenames are underscore-delimited —
+``{cs}_{ds}_{model}_{metric}[_{param}]`` — so approach names that
+themselves contain underscores are collapsed to their display aliases
+before splitting. Only the first ten model runs count toward the
+published timing averages (reference behavior).
+"""
+
 import pickle
+from pathlib import Path
+from typing import Dict, Optional, Tuple
 
 from simple_tip_tpu.config import output_folder
 
 N_FIRST_MODELS_CONSIDERED = 10
 
+# Underscore-bearing approach names -> display aliases, longest first so
+# "softmax_entropy" never half-matches as "softmax".
+_ALIASES = (
+    ("softmax_entropy", "SE"),
+    ("deep_gini", "DeepGini"),
+    ("softmax", "SM"),
+    ("pcs", "PCS"),
+)
 
-def load_times():
-    """Load all timing records keyed by (cs, dataset, model, metric, param)."""
-    times = dict()
-    folder = os.path.join(output_folder(), "times")
-    for root, dirs, files in os.walk(folder):
-        for file in files:
-            file_san = (
-                file.replace("softmax_entropy", "SE")
-                .replace("pcs", "PCS")
-                .replace("deep_gini", "DeepGini")
-                .replace("softmax", "SM")
-            )
-            split = file_san.split("_")
-            if len(split) == 5:
-                case_study, dataset, model_id, metric, param = split
-            else:
-                case_study, dataset, model_id, metric = split
-                param = ""
-            if int(model_id) >= N_FIRST_MODELS_CONSIDERED:
-                continue
-            with open(os.path.join(root, file), "rb") as f:
-                times[(case_study, dataset, model_id, metric, param)] = pickle.load(f)
+TimesKey = Tuple[str, str, str, str, str]
+
+
+def _parse_name(name: str) -> Optional[TimesKey]:
+    """``{cs}_{ds}_{model}_{metric}[_{param}]`` -> 5-tuple key, or None."""
+    for needle, alias in _ALIASES:
+        name = name.replace(needle, alias)
+    fields = name.split("_")
+    if len(fields) == 4:
+        fields.append("")  # param-less approaches (uncertainty family)
+    if len(fields) != 5:
+        return None
+    return tuple(fields)
+
+
+def load_times() -> Dict[TimesKey, list]:
+    """All timing records on the bus, keyed (cs, ds, model, metric, param)."""
+    times: Dict[TimesKey, list] = {}
+    folder = Path(output_folder()) / "times"
+    if not folder.is_dir():
+        return times
+    for path in sorted(p for p in folder.rglob("*") if p.is_file()):
+        key = _parse_name(path.name)
+        if key is None or int(key[2]) >= N_FIRST_MODELS_CONSIDERED:
+            continue
+        times[key] = pickle.loads(path.read_bytes())
     return times

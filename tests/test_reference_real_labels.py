@@ -1,0 +1,121 @@
+"""Onramp vs the reference's REAL shipped label files (round-3 verdict #2).
+
+The reference tree ships exactly two real data artifacts this zero-egress
+environment can read: ``datasets/mnist_c_labels.npy`` and
+``datasets/fmnist-c-test-labels.npy`` (images stripped). They are the only
+real-data oracles available offline, and these tests pin the onramp
+(`data/real_onramp.py`) to them:
+
+- fmnist-c: ``prepare_fmnist_c`` passes labels through untouched, so its
+  output must be BYTE-identical to the shipped file (dtype included).
+- mnist-c: the reference builds its 10k OOD set as per-corruption absolute
+  slices ``[i*667, min(10000,(i+1)*667))`` then applies an UNSEEDED tf
+  shuffle before persisting (case_study_mnist.py:176-209) — so order-level
+  reproduction is impossible by the reference's own construction, and the
+  checkable contract is: the slice math covers each of the 10k test
+  indices exactly once (identity coverage), hence the output is a
+  permutation of the underlying test labels — which is precisely the
+  relationship the shipped file bears to the canonical MNIST test set
+  (class histogram [980 1135 ... 1009], verified here against the real
+  file).
+
+Both real files additionally get their class histograms checked against
+the public MNIST / Fashion-MNIST test-set distributions — a corruption of
+the shipped artifacts (or a broken load path) fails loudly rather than
+vacuously passing.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from simple_tip_tpu.data.real_onramp import (
+    MNIST_CORRUPTION_TYPES,
+    OOD_SIZE,
+    prepare_fmnist_c,
+    prepare_mnist_c,
+)
+
+REF_DATASETS = "/root/reference/datasets"
+MNIST_C_REF = os.path.join(REF_DATASETS, "mnist_c_labels.npy")
+FMNIST_C_REF = os.path.join(REF_DATASETS, "fmnist-c-test-labels.npy")
+
+# Canonical test-set class histograms (public datasets; offline constants).
+MNIST_TEST_HIST = [980, 1135, 1032, 1010, 982, 892, 958, 1028, 974, 1009]
+FMNIST_TEST_HIST = [1000] * 10
+
+needs_reference = pytest.mark.skipif(
+    not (os.path.exists(MNIST_C_REF) and os.path.exists(FMNIST_C_REF)),
+    reason="reference tree with shipped label files not mounted",
+)
+
+
+@needs_reference
+def test_shipped_files_match_canonical_distributions():
+    """Guard the oracles themselves: the shipped files must be the real
+    10k test-label sets, not truncated/corrupted copies."""
+    mnist_c = np.load(MNIST_C_REF)
+    fmnist_c = np.load(FMNIST_C_REF)
+    assert mnist_c.shape == (10_000,)
+    assert fmnist_c.shape == (10_000,)
+    assert np.bincount(mnist_c, minlength=10).tolist() == MNIST_TEST_HIST
+    assert np.bincount(fmnist_c, minlength=10).tolist() == FMNIST_TEST_HIST
+
+
+@needs_reference
+def test_prepare_fmnist_c_labels_byte_identical(tmp_path):
+    """Our cache's labels must be byte-for-byte the reference's file."""
+    images = tmp_path / "fmnist-c-test.npy"
+    np.save(images, np.zeros((10_000, 28, 28), np.uint8))
+    img_path, lab_path = prepare_fmnist_c(
+        str(images), FMNIST_C_REF, out_dir=str(tmp_path)
+    )
+    ours = np.load(lab_path)
+    ref = np.load(FMNIST_C_REF)
+    assert ours.dtype == ref.dtype == np.int64
+    assert ours.tobytes() == ref.tobytes()
+    x = np.load(img_path)
+    assert x.shape == (10_000, 28, 28, 1) and x.dtype == np.float32
+
+
+@needs_reference
+def test_mnist_c_selection_is_permutation_of_shipped(tmp_path):
+    """The slice math must cover each test index exactly once, making the
+    output label multiset identical to the shipped file's — the tightest
+    possible pin given the reference's unseeded shuffle."""
+    ref = np.load(MNIST_C_REF)
+    # Raw mnist-c layout: every corruption folder carries the SAME 10k
+    # test labels (corruptions preserve label order). Use the shipped
+    # array as that underlying label set — its distribution is the real
+    # one (asserted above) — and tag each corruption's images with its
+    # index so provenance of every output row is checkable.
+    raw = tmp_path / "mnist_c"
+    for i, corr in enumerate(MNIST_CORRUPTION_TYPES):
+        d = raw / corr
+        d.mkdir(parents=True)
+        np.save(d / "test_labels.npy", ref)
+        np.save(
+            d / "test_images.npy",
+            np.full((10_000, 28, 28), i, np.uint8),
+        )
+    img_path, lab_path = prepare_mnist_c(str(raw), out_dir=str(tmp_path))
+    ours = np.load(lab_path)
+    assert ours.shape == (OOD_SIZE,)
+
+    # Identity coverage: slices [i*667, (i+1)*667) ∪ ... = [0, 10000)
+    # exactly once, so the output equals the underlying labels in order...
+    assert np.array_equal(ours, ref)
+    # ...and is therefore a permutation of the shipped file (multiset
+    # equality) — the invariant the unseeded shuffle preserves.
+    assert np.bincount(ours, minlength=10).tolist() == np.bincount(
+        ref, minlength=10
+    ).tolist()
+
+    # Provenance: corruption i must occupy rows [i*667, min(10k,(i+1)*667)).
+    imgs = np.load(img_path)
+    assert imgs.shape == (OOD_SIZE, 28, 28, 1)
+    per = -(-OOD_SIZE // len(MNIST_CORRUPTION_TYPES))  # ceil = 667
+    for i in range(len(MNIST_CORRUPTION_TYPES)):
+        lo, hi = i * per, min(OOD_SIZE, (i + 1) * per)
+        assert (imgs[lo:hi] == i).all()
