@@ -160,6 +160,17 @@ def _load_artifact(path: Path):
     return pickle.loads(path.read_bytes())
 
 
+# An evaluation sweep can call load_all_for_regex repeatedly with the same
+# (folder, regex) — at 100-run scale each call unpickles thousands of files.
+# A single-entry memo (most recent key only, so peak RSS never holds more
+# than one hit set) short-circuits the immediate repeat; it is invalidated
+# by any (name, size, mtime_ns) change in the hit set, so a phase writing
+# new artifacts mid-process is picked up on the next call. The unpickled
+# objects themselves are shared between hits — callers treat artifacts as
+# read-only (they aggregate, never mutate).
+_ARTIFACT_MEMO: dict = {}
+
+
 def load_all_for_regex(research_question: str, regex: re.Pattern) -> Tuple[List, List]:
     """(contents, filenames) of every artifact in a bus subfolder whose name
     matches ``regex`` at position 0. Filenames sort deterministically (the
@@ -170,7 +181,17 @@ def load_all_for_regex(research_question: str, regex: re.Pattern) -> Tuple[List,
     hits = sorted(
         p for p in folder.rglob("*") if p.is_file() and regex.match(p.name, pos=0)
     )
-    return [_load_artifact(p) for p in hits], [p.name for p in hits]
+    stamp = tuple((p.name, s.st_size, s.st_mtime_ns) for p in hits for s in (p.stat(),))
+    memo_key = (str(folder), regex.pattern, regex.flags)
+    cached = _ARTIFACT_MEMO.get(memo_key)
+    if cached is not None and cached[0] == stamp:
+        contents, names = cached[1]
+        return list(contents), list(names)
+    contents = [_load_artifact(p) for p in hits]
+    names = [p.name for p in hits]
+    _ARTIFACT_MEMO.clear()
+    _ARTIFACT_MEMO[memo_key] = (stamp, (contents, names))
+    return list(contents), list(names)
 
 
 def identify_incomplete_values(
