@@ -160,15 +160,17 @@ def _load_artifact(path: Path):
     return pickle.loads(path.read_bytes())
 
 
-# An evaluation sweep can call load_all_for_regex repeatedly with the same
-# (folder, regex) — at 100-run scale each call unpickles thousands of files.
-# A single-entry memo (most recent key only, so peak RSS never holds more
-# than one hit set) short-circuits the immediate repeat; it is invalidated
-# by any (name, size, mtime_ns) change in the hit set, so a phase writing
+# The two AL evaluations (table + correlation) each sweep the SAME
+# (folder, per-approach regex) keys — at 100-run scale every sweep
+# re-unpickles thousands of small accuracy dicts. A bounded FIFO memo lets
+# the second and later sweeps skip the unpickling; an entry is invalidated
+# by any (name, size, mtime_ns) change in its hit set, so a phase writing
 # new artifacts mid-process is picked up on the next call. The unpickled
 # objects themselves are shared between hits — callers treat artifacts as
-# read-only (they aggregate, never mutate).
-_ARTIFACT_MEMO: dict = {}
+# read-only (they aggregate, never mutate). The bound comfortably covers
+# one full sweep's distinct keys (approaches x splits) while capping RSS.
+_ARTIFACT_MEMO: "dict" = {}
+_ARTIFACT_MEMO_MAX = 256
 
 
 def load_all_for_regex(research_question: str, regex: re.Pattern) -> Tuple[List, List]:
@@ -189,7 +191,8 @@ def load_all_for_regex(research_question: str, regex: re.Pattern) -> Tuple[List,
         return list(contents), list(names)
     contents = [_load_artifact(p) for p in hits]
     names = [p.name for p in hits]
-    _ARTIFACT_MEMO.clear()
+    while len(_ARTIFACT_MEMO) >= _ARTIFACT_MEMO_MAX:
+        _ARTIFACT_MEMO.pop(next(iter(_ARTIFACT_MEMO)))
     _ARTIFACT_MEMO[memo_key] = (stamp, (contents, names))
     return list(contents), list(names)
 

@@ -40,8 +40,8 @@ def test_study_resumes_and_skips_ok_runs(harness, tmp_path, monkeypatch):
 
     calls = []
 
-    def fake_phase(phase, cs, run_id, timeout_s):
-        calls.append((phase, run_id))
+    def fake_phase(phase, cs, run_id, timeout_s, env=None):
+        calls.append((phase, run_id, bool(env)))
         return {"ok": True, "seconds": 1.0, "error": None}
 
     monkeypatch.setattr(harness, "_cli_phase", fake_phase)
@@ -56,9 +56,11 @@ def test_study_resumes_and_skips_ok_runs(harness, tmp_path, monkeypatch):
     rc = harness.main()
     assert rc == 0
     # training run 0 was NOT re-run; everything else was
-    assert ("training", 0) not in calls
-    assert ("training", 1) in calls
-    assert ("active_learning", 1) in calls
+    assert ("training", 0, False) not in calls
+    assert ("training", 1, False) in calls
+    assert ("active_learning", 1, False) in calls
+    # the host-math phase defaults to the cpu pin (round-4 tunnel postmortem)
+    assert ("test_prio", 0, True) in calls
 
     study = json.load(open(study_json))
     assert study["complete"] is True
@@ -80,7 +82,7 @@ def test_study_stops_on_wedge_and_persists_partial(harness, tmp_path, monkeypatc
     monkeypatch.setenv("TIP_SYNTH_SCALE", "paper")
     study_json = str(tmp_path / "STUDY.json")
 
-    def fake_phase(phase, cs, run_id, timeout_s):
+    def fake_phase(phase, cs, run_id, timeout_s, env=None):
         if run_id == 1:
             return {"ok": False, "seconds": timeout_s, "error": "timed out after 5s"}
         return {"ok": True, "seconds": 2.0, "error": None}
@@ -107,7 +109,37 @@ def test_study_stops_on_wedge_and_persists_partial(harness, tmp_path, monkeypatc
 def test_probe_down_exits_1_and_logs(harness, tmp_path, monkeypatch):
     monkeypatch.setattr(harness, "_probe_platform", lambda timeout_s=90.0: "down")
     monkeypatch.setattr(harness, "REPO", str(tmp_path))
-    monkeypatch.setattr(sys, "argv", ["prog"])
+    # with test_prio on the default platform there is nothing runnable
+    monkeypatch.setattr(sys, "argv", ["prog", "--host-phase-platform", "default"])
     assert harness.main() == 1
     log = (tmp_path / "TUNNEL_PROBES.jsonl").read_text().strip()
     assert json.loads(log)["platform"] == "down"
+
+
+def test_probe_down_still_runs_cpu_pinned_phase(harness, tmp_path, monkeypatch):
+    """A dead tunnel must not waste the window: the cpu-pinned test_prio
+    runs anyway; the tunnel-bound phases defer to the next healthy window."""
+    monkeypatch.setattr(harness, "_probe_platform", lambda timeout_s=90.0: "down")
+    monkeypatch.setattr(harness, "REPO", str(tmp_path))
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "assets"))
+    monkeypatch.setenv("TIP_DATA_DIR", str(tmp_path / "data"))
+    calls = []
+
+    def fake_phase(phase, cs, run_id, timeout_s, env=None):
+        calls.append((phase, run_id, bool(env)))
+        return {"ok": True, "seconds": 1.0, "error": None}
+
+    monkeypatch.setattr(harness, "_cli_phase", fake_phase)
+    monkeypatch.setattr(harness, "_run_bench", lambda: {"degraded": True})
+    study_json = str(tmp_path / "STUDY.json")
+    monkeypatch.setattr(
+        sys,
+        "argv",
+        ["prog", "--runs", "2", "--study-json", study_json,
+         "--bench-json", str(tmp_path / "b.json")],
+    )
+    assert harness.main() == 0
+    assert calls == [("test_prio", 0, True), ("test_prio", 1, True)]
+    study = json.load(open(study_json))
+    assert study["phases"]["test_prio"]["0"]["platform"] == "cpu-pinned"
+    assert study["complete"] is False  # tunnel-bound phases still pending
