@@ -75,6 +75,33 @@ def main() -> int:
         timings[f"{cs_name}/test_prio"] = round(time.time() - t0, 1)
         print(f"[{cs_name}] test_prio done in {timings[f'{cs_name}/test_prio']}s", flush=True)
 
+        if cs_name == CASE_STUDIES[0] and args.workers > 1:
+            # Measured worker-axis table (round-3 verdict, next-step #8): on
+            # this 1-core host the honest claims are "no speedup" and
+            # "bounded scheduler overhead", both measured here by re-running
+            # the SAME phase single-worker on a fresh bus. (A speedup table
+            # needs a multi-core host; the phase is embarrassingly parallel
+            # over run ids.)
+            solo_assets = os.path.join(args.assets, "workers1")
+            prev = os.environ["TIP_ASSETS"]
+            os.environ["TIP_ASSETS"] = solo_assets
+            try:
+                shutil.copytree(
+                    os.path.join(prev, "models"),
+                    os.path.join(solo_assets, "models"),
+                    dirs_exist_ok=True,
+                )
+                t0 = time.time()
+                cs.run_prio_eval(run_ids, num_workers=1)
+                timings[f"{cs_name}/test_prio_workers1"] = round(time.time() - t0, 1)
+                print(
+                    f"[{cs_name}] test_prio single-worker rerun in "
+                    f"{timings[f'{cs_name}/test_prio_workers1']}s",
+                    flush=True,
+                )
+            finally:
+                os.environ["TIP_ASSETS"] = prev
+
         al_runs = run_ids[:-1] if cs_name == "mini-mnist" else run_ids
         t0 = time.time()
         cs.run_active_learning_eval(al_runs, num_workers=args.workers)
