@@ -75,6 +75,20 @@ def main() -> int:
     )
     args = ap.parse_args()
 
+    if args.require_device and args.skip_device:
+        ap.error("--require-device contradicts --skip-device")
+
+    early_platform = None
+    if args.require_device:
+        # cheap probe BEFORE the expensive profile generation + host passes:
+        # a retry loop during an outage should cost seconds, not minutes
+        from simple_tip_tpu.utils.device_watchdog import ensure_responsive_backend
+
+        early_platform = ensure_responsive_backend(timeout_s=90.0)
+        if early_platform == "cpu":
+            print("accelerator unresponsive and --require-device set: aborting")
+            return 1
+
     from simple_tip_tpu.ops import prioritizers as P
 
     profiles, scores = make_profiles(args.samples, args.sections, args.density)
@@ -127,7 +141,7 @@ def main() -> int:
     else:
         from simple_tip_tpu.utils.device_watchdog import ensure_responsive_backend
 
-        platform = ensure_responsive_backend(timeout_s=90.0)
+        platform = early_platform or ensure_responsive_backend(timeout_s=90.0)
         record["device_platform"] = platform
         if platform == "cpu":
             record["backends"]["device"] = None

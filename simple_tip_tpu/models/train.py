@@ -109,10 +109,28 @@ def make_epoch_fn(model, tx: optax.GradientTransformation, batch_size: int) -> C
     return partial(jax.jit, donate_argnums=(0, 1))(make_epoch_core(model, tx, batch_size))
 
 
+@lru_cache(maxsize=64)
+def _make_init_fn(model) -> Callable:
+    """One jitted init program per model config (jit re-specializes per
+    example shape on its own).
+
+    Flax's ``module.init`` runs eagerly — every primitive dispatches (and
+    round-trips the persistent compilation cache) separately, which on this
+    deployment measured SECONDS per init and dominated active-learning
+    retrains (~80 inits/run). Jitted, init is one cached program and the
+    warm call is ~1 ms."""
+
+    @jax.jit
+    def init(rng, example_x):
+        variables = model.init({"params": rng, "dropout": rng}, example_x, train=False)
+        return variables["params"]
+
+    return init
+
+
 def init_params(model, rng, example_x) -> Any:
     """Initialize model parameters for an example input batch."""
-    variables = model.init({"params": rng, "dropout": rng}, example_x, train=False)
-    return variables["params"]
+    return _make_init_fn(model)(rng, example_x)
 
 
 def train_model(

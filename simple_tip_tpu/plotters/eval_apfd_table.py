@@ -123,7 +123,10 @@ def _plot_latex_table(pd_df: pd.DataFrame) -> None:
             multicolumn_format="c",
             multirow=True,
             column_format="llcccccccccccc",
-            float_format="{:.2%}".format,
+            # pandas>=2 to_latex no longer escapes cell text, so the percent
+            # sign must be emitted pre-escaped or it comments out the rest
+            # of every data row
+            float_format=lambda v: f"{v:.2%}".replace("%", r"\%"),
         )
     except Exception as e:
         warnings.warn(f"latex table rendering failed: {e}")
