@@ -141,7 +141,9 @@ def main() -> int:
     else:
         from simple_tip_tpu.utils.device_watchdog import ensure_responsive_backend
 
-        platform = early_platform or ensure_responsive_backend(timeout_s=90.0)
+        # fresh probe even after an early one: the host passes above take
+        # minutes, plenty of time for the tunnel to wedge
+        platform = ensure_responsive_backend(timeout_s=90.0)
         record["device_platform"] = platform
         if platform == "cpu":
             record["backends"]["device"] = None

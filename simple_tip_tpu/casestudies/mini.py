@@ -6,7 +6,8 @@ datasets — which a single-core host cannot push through a 10-run x
 all-phases study in useful time. These minis keep every STRUCTURAL property
 the evaluation layer depends on (10 classes, dropout vs no-dropout model
 families, nominal + corrupted-OOD eval sets, the same tap layout and
-artifact contract) at ~1/40 the compute, so a full multi-run study —
+artifact contract) at ~1/100 the compute (sized against this host's measured ~45 s/retrain
+XLA:CPU cost at 1200-sample scale — the phase the chip accelerates), so a full multi-run study —
 train → test_prio → active_learning → all four evaluations — runs
 end-to-end in minutes-per-run (scripts/mini_study.py, committed results
 under results/mini_study_r04/).
@@ -25,8 +26,8 @@ from simple_tip_tpu.data import synthetic
 from simple_tip_tpu.models import Cifar10ConvNet, MnistConvNet
 from simple_tip_tpu.models.train import TrainConfig
 
-N_TRAIN = 1200
-N_TEST = 400
+N_TRAIN = 600
+N_TEST = 300
 
 
 def _image_loader(shape, seed: int):
@@ -53,7 +54,7 @@ MINI_CASE_STUDIES = {
         sa_activation_layers=(3,),
         prediction_badge_size=128,
         num_classes=10,
-        al_num_selected=64,
+        al_num_selected=48,
     ),
     "mini-cifar10": CaseStudySpec(
         name="mini-cifar10",
@@ -64,7 +65,7 @@ MINI_CASE_STUDIES = {
         sa_activation_layers=(3,),
         prediction_badge_size=128,
         num_classes=10,
-        al_num_selected=64,
+        al_num_selected=48,
     ),
 }
 

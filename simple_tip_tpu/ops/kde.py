@@ -85,6 +85,17 @@ class StableGaussianKDE:
     def _stabilize_covariance(self, covariance: np.ndarray):
         """Replace the diagonal with a doubling increment until the scaled
         covariance is numerically positive definite, or fail silently."""
+        if not np.isfinite(covariance).all():
+            # e.g. a single-sample dataset: np.cov's n-1 divisor yields
+            # NaN/inf, which would sail through the eigenvalue loop (NaN
+            # comparisons are False) and explode in cholesky's finiteness
+            # check. Same silent degraded mode as an unstabilizable matrix.
+            warnings.warn(
+                "Covariance matrix is not finite (too few samples?). "
+                "Failing silently. All likelihoods will be reported as 0."
+            )
+            self.prepare_failed = True
+            return None
         increment = 1e-10
         while np.any(np.linalg.eigh(covariance * self.factor**2)[0] <= 0):
             np.fill_diagonal(covariance, increment)
