@@ -1,0 +1,28 @@
+"""Sequential in-process mini-study AL (no scheduler, no wedge timeouts).
+
+Usage: python scripts/_mini_al_seq.py [mini-mnist] [0,1]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from scripts.mini_env import bootstrap  # noqa: E402
+
+
+def main():
+    bootstrap()
+    from simple_tip_tpu.casestudies.mini import provide
+
+    cs_name = sys.argv[1] if len(sys.argv) > 1 else "mini-mnist"
+    runs = [int(r) for r in (sys.argv[2] if len(sys.argv) > 2 else "0,1").split(",")]
+    cs = provide(cs_name)
+    for rid in runs:
+        t0 = time.time()
+        cs.run_active_learning_eval([rid], num_workers=1)
+        print(f"[{cs_name}] AL run {rid} done in {time.time()-t0:.0f}s", flush=True)
+    print(f"{cs_name} AL complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Mini-study per-case-study training + class-coverage preflight + test_prio.
+
+Module-level work MUST stay behind the main guard: the run scheduler's
+spawned workers re-import __main__, and unguarded phase calls would
+re-execute recursively in every worker.
+
+Usage: python scripts/_mini_cifar_phases.py [mini-cifar10] [workers]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from scripts.mini_env import bootstrap  # noqa: E402
+
+
+def main():
+    bootstrap()
+    import numpy as np
+
+    from simple_tip_tpu.casestudies.mini import provide
+    from simple_tip_tpu.models.train import make_predict_fn
+
+    cs_name = sys.argv[1] if len(sys.argv) > 1 else "mini-cifar10"
+    workers = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+    cs = provide(cs_name)
+    run_ids = list(range(10))
+
+    t0 = time.time()
+    cs.train(run_ids, use_mesh=False, group_size=1)
+    print(f"[{cs_name}] training done in {time.time()-t0:.1f}s", flush=True)
+
+    (x_tr, _), (x_te, _), (x_ood, _) = cs.spec.loader()
+    predict = make_predict_fn(cs.scoring_model_def)
+    for rid in run_ids:
+        params = cs.load_params(rid)
+        train_classes = set(np.argmax(predict(params, x_tr), axis=1).tolist())
+        eval_classes = set(np.argmax(predict(params, x_te), axis=1).tolist())
+        eval_classes |= set(np.argmax(predict(params, x_ood), axis=1).tolist())
+        uncovered = eval_classes - train_classes
+        if uncovered:
+            raise SystemExit(
+                f"[{cs_name}] run {rid} predicts classes {sorted(uncovered)} "
+                f"on eval data but never on train data — per-class SA would "
+                f"fail (reference semantics). Delete this run's checkpoint "
+                f"under $TIP_ASSETS/models/{cs_name}/ and retrain with more "
+                f"epochs in casestudies/mini.py."
+            )
+    print(f"[{cs_name}] class-coverage preflight OK", flush=True)
+
+    t0 = time.time()
+    cs.run_prio_eval(run_ids, num_workers=workers)
+    print(f"[{cs_name}] test_prio done in {time.time()-t0:.1f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Shared environment bootstrap for the mini-study phase scripts.
+
+One definition so scripts/mini_study.py and the per-phase helpers
+(scripts/_mini_*.py) cannot drift apart on scheduler/backend settings.
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def bootstrap(assets: str = "/tmp/mini_study_assets") -> None:
+    """Env + jax platform binding for a host-side mini-study process."""
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("TIP_ASSETS", assets)
+    os.environ.setdefault("TIP_DATA_DIR", os.path.join(assets, "no-real-data"))
+    os.environ["TIP_CASE_STUDY_PROVIDER"] = "simple_tip_tpu.casestudies.mini:provide"
+    # Same-backend workers => reproducible artifacts (SCALING.md note).
+    os.environ.setdefault("TIP_WORKER_PLATFORMS", "cpu")
+    # One AL run is ~80 sequential CPU retrains (~40 min alone, slower under
+    # contention): the scheduler's default 1h wedge timeout would terminate
+    # and requeue genuinely-working workers.
+    os.environ.setdefault("TIP_RUN_TIMEOUT_S", "10800")
+
+    import jax
+
+    # Bind CPU BEFORE anything touches the backend registry (the env var
+    # alone is silently ignored — sitecustomize pre-registers the TPU
+    # plugin; and probing a dead tunnel would hang).
+    jax.config.update("jax_platforms", "cpu")

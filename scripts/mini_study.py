@@ -49,22 +49,12 @@ def main() -> int:
     ap.add_argument("--out", default=os.path.join(REPO, "results", "mini_study_r04"))
     args = ap.parse_args()
 
-    os.environ.setdefault("TIP_ASSETS", args.assets)
-    os.environ.setdefault("TIP_DATA_DIR", os.path.join(args.assets, "no-real-data"))
-    os.environ["TIP_CASE_STUDY_PROVIDER"] = "simple_tip_tpu.casestudies.mini:provide"
-    # Same-backend workers => reproducible artifacts (SCALING.md note).
-    os.environ.setdefault("TIP_WORKER_PLATFORMS", "cpu")
+    # Shared bootstrap (scripts/mini_env.py): asset/provider env, cpu-pinned
+    # same-backend workers, raised scheduler wedge timeout, and the
+    # bind-cpu-before-backend-init ordering this deployment requires.
+    from scripts.mini_env import bootstrap
 
-    import jax
-
-    # Host-side framework validation: bind CPU BEFORE anything touches the
-    # backend registry. Calling default_backend() first would (a) make this
-    # update a silent no-op (backends are cached on first init) and (b) on
-    # this deployment hang probing the tunnel during an outage. The env var
-    # alone is not enough either — sitecustomize pre-registers the TPU
-    # plugin — so jax.config is the binding mechanism. TPU evidence capture
-    # is the capture harness's job, not this script's.
-    jax.config.update("jax_platforms", "cpu")
+    bootstrap(args.assets)
 
     from simple_tip_tpu.casestudies.mini import provide
 

@@ -492,8 +492,36 @@ class MLSA(SA):
 
         activations = _flatten_layers(activations)
         logger.info("Fitting Gaussian Mixture with %d components", num_components)
-        self.gmm = GaussianMixture(n_components=num_components, random_state=seed)
-        self.gmm.fit(activations)
+        # Degenerate activation sets (collapsed features / near-singleton
+        # components at small scale) can make the default reg_covar=1e-6 fit
+        # raise; escalating the covariance regularization is sklearn's own
+        # documented remedy and keeps the metric defined where the
+        # reference's fixed-default fit would abort the whole run.
+        last_error = None
+        ladder = (1e-6, 1e-4, 1e-2)
+        for reg_covar in ladder:
+            try:
+                self.gmm = GaussianMixture(
+                    n_components=num_components,
+                    random_state=seed,
+                    reg_covar=reg_covar,
+                )
+                self.gmm.fit(activations)
+                # The jnp backend's fixed-iteration EM never raises from
+                # fit; a near-singular component only explodes later in
+                # score_samples' cholesky. Probe one row so BOTH backends
+                # surface degeneracy here, inside the escalation.
+                self.gmm.score_samples(activations[:1])
+                break
+            except ValueError as e:  # includes LinAlgError
+                last_error = e
+                if reg_covar != ladder[-1]:
+                    warnings.warn(
+                        f"GMM fit failed at reg_covar={reg_covar:g} ({e}); "
+                        "retrying with stronger covariance regularization"
+                    )
+        else:
+            raise last_error
 
     def __call__(
         self,
