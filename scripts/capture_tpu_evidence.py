@@ -32,6 +32,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 PROBE = (
     "import jax, jax.numpy as jnp; "
@@ -89,10 +90,13 @@ def _load_study(path: str) -> dict:
 
 
 def _save_study(path: str, study: dict) -> None:
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(study, f, indent=1)
-    os.replace(tmp, path)
+    # Backend-safe to import: sitecustomize preloads the jax MODULE in
+    # every process regardless; the harness's load-bearing contract is
+    # never touching the backend/tunnel from this parent, and an atomic
+    # json write doesn't.
+    from simple_tip_tpu.utils.artifacts_io import atomic_write_json
+
+    atomic_write_json(path, study)
 
 
 def _cli_phase(
@@ -174,6 +178,12 @@ def main() -> int:
     if not tunnel_up and args.host_phase_platform != "cpu":
         print("accelerator not reachable — nothing captured, try again later")
         return 1
+    # Exit-code contract (watcher depends on it):
+    #   0 = healthy window, capture ran to the end
+    #   1 = nothing runnable (tunnel down, no cpu-pinned phases requested)
+    #   2 = mid-study tunnel wedge (window closed; resumable)
+    #   3 = tunnel down, only the cpu-pinned phases ran (NOT a healthy
+    #       window — callers must not fire one-shot device captures on it)
     if not tunnel_up:
         # The cpu-pinned study phases don't need the tunnel; bench and the
         # tunnel-bound phases are skipped per-run below and picked up in
@@ -218,6 +228,24 @@ def main() -> int:
     study.setdefault("case_study", args.case_study)
     study.setdefault("runs_requested", args.runs)
     study["platform"] = platform
+    # Synthetic-hardness provenance: the stand-in generators' calibrated
+    # ambiguity (TIP_SYNTH_HARDNESS, data/synthetic.py) must be IDENTICAL
+    # across every phase of one study — checkpoints trained on one
+    # generation must never be evaluated/AL-retrained on another. The value
+    # is pinned in the study JSON at creation and re-applied on every
+    # resume, so no caller has to remember an env prefix. Studies begun
+    # before the field existed (STUDY_r03) were generated pre-hardness:
+    # they pin 0.
+    if "synth_hardness" not in study:
+        if os.environ.get("TIP_SYNTH_HARDNESS"):
+            study["synth_hardness"] = float(os.environ["TIP_SYNTH_HARDNESS"])
+        elif study["phases"]:
+            study["synth_hardness"] = 0.0  # pre-field study: pre-hardness data
+        else:
+            from simple_tip_tpu.data.synthetic import DEFAULT_HARDNESS
+
+            study["synth_hardness"] = DEFAULT_HARDNESS
+    os.environ["TIP_SYNTH_HARDNESS"] = str(study["synth_hardness"])
     # Per-phase platform policy (round-4 outage postmortem): test_prio is
     # the tunnel-hostile phase — it launches many heterogeneous small
     # programs (12 coverage configs, DSA chunks, cluster EM), each paying
@@ -233,6 +261,11 @@ def main() -> int:
         p: ("cpu-pinned" if env else "default") for p, env in phase_env.items()
     }
     phases = study["phases"]
+    # The startup probe goes stale in both directions during a long study;
+    # the exit code must reflect what was OBSERVED, not the startup guess
+    # (the watcher gates its one-shot device captures on it).
+    saw_device_run = False
+    lost_tunnel = False
     for phase in ("training", "test_prio", "active_learning"):
         per_run = phases.setdefault(phase, {})
         env = phase_env[phase]
@@ -240,6 +273,14 @@ def main() -> int:
             key = str(run_id)
             if per_run.get(key, {}).get("ok"):
                 continue  # already captured in an earlier window
+            if phase != "training" and not phases.get("training", {}).get(
+                key, {}
+            ).get("ok"):
+                # pipeline order: without this run's checkpoint the phase
+                # would only fail after paying dataset generation — a fresh
+                # study during an outage would otherwise burn minutes per
+                # watcher cycle failing loudly on every untrained run.
+                continue
             if env:
                 run_platform = "cpu-pinned"
             else:
@@ -252,7 +293,9 @@ def main() -> int:
                     # leave the remaining runs for the next window instead
                     # of wedging into the phase timeout run after run.
                     print(f"[{phase}] tunnel lost — deferring remaining runs")
+                    lost_tunnel = tunnel_up or saw_device_run
                     break
+                saw_device_run = True
             print(f"[{phase}] run {run_id} ...", flush=True)
             rec = _cli_phase(phase, args.case_study, run_id, args.phase_timeout, env)
             rec["platform"] = run_platform
@@ -273,7 +316,16 @@ def main() -> int:
                     return 2
 
     _finalize(study, args)
-    return 0
+    if not saw_device_run and not lost_tunnel:
+        # No tunnel-bound run executed (all captured earlier, or only the
+        # cpu-pinned tail ran — possibly for hours): the startup probe is
+        # stale in both directions by now, and the watcher's one-shot gate
+        # needs CURRENT truth. One bounded re-probe settles it.
+        tunnel_up = _probe_platform(45.0) not in ("down", "cpu")
+    device_window = tunnel_up or saw_device_run
+    if device_window and not lost_tunnel:
+        return 0  # healthy window throughout the observed device work
+    return 2 if device_window else 3
 
 
 def _finalize(study: dict, args) -> None:

@@ -1,28 +1,63 @@
 #!/bin/bash
-# Background tunnel watcher (round-4): probe the TPU tunnel every ~15 min
-# and, the moment a window opens, capture the full evidence set:
-#   1. scripts/capture_tpu_evidence.py — bench_tpu.json + the resumable
-#      multi-run study (cpu-pinned phases run even during outages)
-#   2. scripts/validate_tpu_kernels.py — per-kernel device evidence
+# Background tunnel watcher (round-5): probe the TPU tunnel every ~15 min
+# and, the moment a window opens, capture the chip evidence set in
+# cheapest-first order (a window can close at any time):
+#   1. scripts/capture_tpu_evidence.py — bench_tpu.json + the STUDY_r03
+#      active-learning completion (training + test_prio already captured;
+#      the preserved /tmp/tpu_study_assets checkpoints were trained on the
+#      pre-hardness fully-separable stand-ins, so the AL completion pins
+#      TIP_SYNTH_HARDNESS=0 to regenerate byte-identical data for them)
+#   2. scripts/profile_bench.py — MFU breakdown of the bench hot path
+#      (MFU_BREAKDOWN.json), once
+#   3. scripts/bench_attention.py --require-device — flash/dense core
+#      rows (ATTENTION_BENCH.json "complete"), once
+#   4. scripts/validate_tpu_kernels.py — per-kernel device evidence
 #      (TPU_KERNELS.json), once
-#   3. scripts/bench_cam.py device backend (CAM_BENCH_DEVICE.json), once
-# Exits only when the bench record, a complete study, and the kernel
-# record all exist.
+#   5. scripts/bench_cam.py device backend (CAM_BENCH_DEVICE.json), once
+#   6. STUDY_r05 — the round-5 paper-scale study on the HARDENED stand-ins
+#      (calibrated nominal misclassifications -> populated nominal APFD):
+#      fresh assets dir, training/AL on the chip when the window holds,
+#      test_prio cpu-pinned (runs during outages too once training exists).
+#      Hardness provenance is recorded in the study JSON at creation.
+#
+# Exit-code gate (round-4 advisor finding): capture_tpu_evidence returns
+# 0 = healthy-window capture, 2 = mid-window drop, 3 = tunnel down and only
+# cpu-pinned phases ran. One-shot device captures fire on 0/2 ONLY — rc 3
+# means no window, and probing device scripts then would just burn ~90 s
+# watchdog timeouts every cycle.
 #
 # Usage: nohup bash scripts/tunnel_watch.sh >/tmp/tunnel_watch.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
 
 STUDY=STUDY_r03.json
+STUDY5=STUDY_r05.json
+
+have_json_flag() { # file key -> 0 when file[key] is truthy
+  python - "$1" "$2" <<'EOF'
+import json, sys
+try:
+    sys.exit(0 if json.load(open(sys.argv[1])).get(sys.argv[2]) else 1)
+except Exception:
+    sys.exit(1)
+EOF
+}
+
 while true; do
   echo "$(date -u +%FT%TZ) probing tunnel"
-  python scripts/capture_tpu_evidence.py --runs 10 --study-json "$STUDY"
+  TIP_SYNTH_HARDNESS=0 python scripts/capture_tpu_evidence.py \
+    --runs 10 --study-json "$STUDY"
   rc=$?
   if [ "$rc" = "0" ] || [ "$rc" = "2" ]; then
-    # capture ran (fully or until a mid-window drop): grab the one-shot
-    # kernel evidence while the window may still be healthy
-    kernels_done=$(python -c "import json;print(int(json.load(open('TPU_KERNELS.json')).get('complete',False)))" 2>/dev/null || echo 0)
-    if [ "$kernels_done" != "1" ]; then
+    # healthy window (fully or partially): grab the one-shot device
+    # evidence, cheapest first, while it may still be open
+    if ! have_json_flag MFU_BREAKDOWN.json complete; then
+      timeout 900 python scripts/profile_bench.py || true
+    fi
+    if ! have_json_flag ATTENTION_BENCH.json complete; then
+      timeout 1800 python scripts/bench_attention.py --require-device || true
+    fi
+    if ! have_json_flag TPU_KERNELS.json complete; then
       timeout 1800 python scripts/validate_tpu_kernels.py || true
     fi
     if [ ! -f CAM_BENCH_DEVICE.json ]; then
@@ -30,21 +65,20 @@ while true; do
         --sections 100000 --skip-numpy --require-device --out CAM_BENCH_DEVICE.json || true
     fi
   fi
-  done_all=$(python - <<EOF
-import json, os
-try:
-    complete = json.load(open("$STUDY")).get("complete", False)
-except Exception:
-    complete = False
-try:
-    kernels = json.load(open("TPU_KERNELS.json")).get("complete", False)
-except Exception:
-    kernels = False
-print(int(bool(complete) and bool(kernels) and os.path.exists("bench_tpu.json")))
-EOF
-)
-  if [ "$done_all" = "1" ]; then
-    echo "$(date -u +%FT%TZ) bench + study + kernel evidence captured; watcher exiting"
+  # round-5 hardened-stand-in study: advance it every cycle (cpu-pinned
+  # test_prio progresses even with the tunnel down once training exists;
+  # its own per-run probes defer tunnel-bound phases)
+  if ! have_json_flag "$STUDY5" complete; then
+    TIP_ASSETS=/tmp/tpu_study_assets_r05 python scripts/capture_tpu_evidence.py \
+      --runs 10 --study-json "$STUDY5"
+  fi
+  if have_json_flag "$STUDY" complete \
+     && have_json_flag "$STUDY5" complete \
+     && have_json_flag TPU_KERNELS.json complete \
+     && have_json_flag ATTENTION_BENCH.json complete \
+     && have_json_flag MFU_BREAKDOWN.json complete \
+     && [ -f bench_tpu.json ] && [ -f CAM_BENCH_DEVICE.json ]; then
+    echo "$(date -u +%FT%TZ) full chip evidence set captured; watcher exiting"
     break
   fi
   sleep 900

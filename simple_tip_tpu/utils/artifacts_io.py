@@ -1,0 +1,27 @@
+"""Atomic JSON persistence for evidence artifacts.
+
+Every measurement script in this repo follows persist-on-measure (a later
+tunnel outage or kill must never erase evidence that already existed); the
+write itself must therefore be atomic — a reader (the driver, the tunnel
+watcher's gating helper) must never observe a half-written file. One shared
+helper instead of per-script copies of the tmp+rename idiom (round-5
+advisor reuse finding).
+"""
+
+import json
+import os
+
+
+def atomic_write_json(path: str, obj, indent: int = 1) -> None:
+    """Write ``obj`` as JSON to ``path`` via tmp-file + atomic rename.
+
+    fsync before the rename: this host loses power/connectivity mid-round
+    often enough that a rename pointing at un-flushed blocks would defeat
+    the persist-on-measure contract.
+    """
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=indent)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -132,14 +132,133 @@ def test_probe_down_still_runs_cpu_pinned_phase(harness, tmp_path, monkeypatch):
     monkeypatch.setattr(harness, "_cli_phase", fake_phase)
     monkeypatch.setattr(harness, "_run_bench", lambda: {"degraded": True})
     study_json = str(tmp_path / "STUDY.json")
+    # training already captured in an earlier healthy window (the pipeline
+    # -order guard defers cpu-pinned phases for untrained runs)
+    with open(study_json, "w") as f:
+        json.dump(
+            {"phases": {"training": {"0": {"ok": True, "seconds": 2.0},
+                                     "1": {"ok": True, "seconds": 2.0}}}},
+            f,
+        )
     monkeypatch.setattr(
         sys,
         "argv",
         ["prog", "--runs", "2", "--study-json", study_json,
          "--bench-json", str(tmp_path / "b.json")],
     )
-    assert harness.main() == 0
+    # rc 3 = cpu-pinned-only degraded window (round-4 advisor: the watcher
+    # must not fire one-shot device captures on this path)
+    assert harness.main() == 3
     assert calls == [("test_prio", 0, True), ("test_prio", 1, True)]
     study = json.load(open(study_json))
     assert study["phases"]["test_prio"]["0"]["platform"] == "cpu-pinned"
     assert study["complete"] is False  # tunnel-bound phases still pending
+
+
+def test_rc_reflects_observed_window_not_startup_probe(
+    harness, tmp_path, monkeypatch
+):
+    """Round-5 review: the exit code the watcher gates on must come from
+    what the per-run probes OBSERVED, not the stale startup probe."""
+    monkeypatch.setattr(harness, "REPO", str(tmp_path))
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "assets"))
+    monkeypatch.setenv("TIP_DATA_DIR", str(tmp_path / "data"))
+    monkeypatch.setattr(harness, "_run_bench", lambda: {"degraded": True})
+    monkeypatch.setattr(
+        harness, "_cli_phase",
+        lambda *a, **k: {"ok": True, "seconds": 1.0, "error": None})
+    study_json = str(tmp_path / "STUDY.json")
+    monkeypatch.setattr(
+        sys, "argv",
+        ["prog", "--runs", "1", "--study-json", study_json,
+         "--bench-json", str(tmp_path / "b.json")])
+
+    # down at startup, but the tunnel RECOVERED by the first per-run probe:
+    # a real device window happened -> rc 0 (watcher may fire one-shots)
+    probes = iter(["down", "axon", "axon"])
+    monkeypatch.setattr(
+        harness, "_probe_platform", lambda timeout_s=90.0: next(probes))
+    assert harness.main() == 0
+    study = json.load(open(study_json))
+    assert study["phases"]["training"]["0"]["platform"] == "axon"
+
+    # up at startup, but DOWN by the first per-run probe of the remaining
+    # tunnel-bound phase: window closed mid-capture -> rc 2, not 0
+    os.remove(study_json)
+    probes2 = iter(["axon", "down", "down"])
+    monkeypatch.setattr(
+        harness, "_probe_platform", lambda timeout_s=90.0: next(probes2))
+    assert harness.main() == 2
+
+
+def test_synth_hardness_pinned_in_study_provenance(
+    harness, tmp_path, monkeypatch
+):
+    """Round-5 review: the generator hardness a study was built with must
+    live in the study JSON and be re-applied on resume — never depend on a
+    caller remembering an env prefix (mixed-generation data would silently
+    corrupt resumed AL deltas)."""
+    monkeypatch.setattr(harness, "REPO", str(tmp_path))
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "assets"))
+    monkeypatch.setenv("TIP_DATA_DIR", str(tmp_path / "data"))
+    monkeypatch.delenv("TIP_SYNTH_HARDNESS", raising=False)
+    monkeypatch.setattr(harness, "_run_bench", lambda: {})
+    monkeypatch.setattr(harness, "_probe_platform", lambda timeout_s=90.0: "axon")
+    seen_env = []
+    monkeypatch.setattr(
+        harness, "_cli_phase",
+        lambda *a, **k: (seen_env.append(os.environ.get("TIP_SYNTH_HARDNESS")),
+                         {"ok": True, "seconds": 1.0, "error": None})[1])
+    study_json = str(tmp_path / "STUDY.json")
+
+    # pre-hardness study (has phases, no field): resumes pinned to 0
+    with open(study_json, "w") as f:
+        json.dump({"phases": {"training": {"0": {"ok": True, "seconds": 1.0}}}}, f)
+    monkeypatch.setattr(
+        sys, "argv",
+        ["prog", "--runs", "1", "--study-json", study_json,
+         "--bench-json", str(tmp_path / "b.json")])
+    assert harness.main() == 0
+    study = json.load(open(study_json))
+    assert study["synth_hardness"] == 0.0
+    assert seen_env and all(e == "0.0" for e in seen_env)
+
+    # fresh study without env: records the generators' default
+    seen_env.clear()
+    monkeypatch.delenv("TIP_SYNTH_HARDNESS", raising=False)
+    os.remove(study_json)
+    assert harness.main() == 0
+    from simple_tip_tpu.data.synthetic import DEFAULT_HARDNESS
+
+    study = json.load(open(study_json))
+    assert study["synth_hardness"] == DEFAULT_HARDNESS
+    assert seen_env and all(e == str(DEFAULT_HARDNESS) for e in seen_env)
+
+
+def test_downstream_phases_wait_for_training(harness, tmp_path, monkeypatch):
+    """A fresh study during an outage must not burn the window failing
+    test_prio on untrained runs: downstream phases skip run ids whose
+    training record is not ok yet (pipeline order)."""
+    monkeypatch.setattr(harness, "REPO", str(tmp_path))
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "assets"))
+    monkeypatch.setenv("TIP_DATA_DIR", str(tmp_path / "data"))
+    monkeypatch.setattr(harness, "_probe_platform", lambda timeout_s=90.0: "down")
+    monkeypatch.setattr(harness, "_run_bench", lambda: {"degraded": True})
+    calls = []
+    monkeypatch.setattr(
+        harness, "_cli_phase",
+        lambda phase, cs, rid, t, env=None: (calls.append((phase, rid)),
+                                             {"ok": True, "seconds": 1.0,
+                                              "error": None})[1])
+    study_json = str(tmp_path / "STUDY.json")
+    # run 0 trained in an earlier window; run 1 not yet
+    with open(study_json, "w") as f:
+        json.dump({"phases": {"training": {"0": {"ok": True, "seconds": 2.0}}}}, f)
+    monkeypatch.setattr(
+        sys, "argv",
+        ["prog", "--runs", "2", "--study-json", study_json,
+         "--bench-json", str(tmp_path / "b.json")])
+    assert harness.main() == 3
+    # cpu-pinned test_prio ran ONLY for the trained run; training and AL
+    # (tunnel-bound) deferred entirely
+    assert calls == [("test_prio", 0)]
