@@ -1,0 +1,156 @@
+"""Calibrated-hardness contract of the synthetic stand-ins (round-4
+verdict, missing #3): a realistic irreducible error so trained models
+misclassify a few percent of NOMINAL inputs and nominal APFD
+(/root/reference/src/core/apfd.py:8-19) is defined and discriminative —
+while TIP_SYNTH_HARDNESS=0 regenerates the pre-hardness data byte-exactly
+(resumed studies depend on it)."""
+
+import numpy as np
+import pytest
+
+from simple_tip_tpu.data import synthetic
+
+
+@pytest.fixture(autouse=True)
+def _no_env_hardness(monkeypatch):
+    monkeypatch.delenv("TIP_SYNTH_HARDNESS", raising=False)
+
+
+def _legacy_images(seed, n_train, n_test, shape, num_classes=10, noise=0.25):
+    """The pre-hardness generator, transcribed as the byte-parity oracle."""
+    rng = np.random.default_rng(seed)
+    h, w, c = shape
+    templates = rng.uniform(0.0, 0.4, size=(num_classes, h, w, c)).astype(np.float32)
+    for cls in range(num_classes):
+        r = (cls * 7919) % (h - 8)
+        col = (cls * 104729) % (w - 8)
+        templates[cls, r : r + 8, col : col + 8, :] += np.float32(0.55)
+
+    def make(n, rng):
+        labels = rng.integers(0, num_classes, size=n)
+        x = templates[labels]
+        x += rng.normal(0, noise, size=(n, h, w, c)).astype(np.float32)
+        x = np.clip(x, 0, 1)
+        x = np.round(x * 255).astype(np.uint8).astype(np.float32) / 255.0
+        return x, labels.astype(np.int64)
+
+    x_train, y_train = make(n_train, rng)
+    x_test, y_test = make(n_test, rng)
+    return (x_train, y_train), (x_test, y_test)
+
+
+def test_hardness_zero_is_byte_identical_to_pre_hardness_images():
+    got = synthetic.image_classification(
+        seed=11, n_train=64, n_test=32, shape=(28, 28, 1), hard_frac=0.0
+    )
+    want = _legacy_images(11, 64, 32, (28, 28, 1))
+    for (xg, yg), (xw, yw) in zip(got, want):
+        np.testing.assert_array_equal(xg, xw)
+        np.testing.assert_array_equal(yg, yw)
+
+
+def test_hardness_env_and_default(monkeypatch):
+    (x0, y0), _ = synthetic.image_classification(
+        seed=3, n_train=400, n_test=10, shape=(16, 16, 1), hard_frac=0.0
+    )
+    # default (no env, no arg) must be nonzero: stand-ins are
+    # non-degenerate out of the box
+    (xd, yd), _ = synthetic.image_classification(
+        seed=3, n_train=400, n_test=10, shape=(16, 16, 1)
+    )
+    assert not np.array_equal(x0, xd)
+    np.testing.assert_array_equal(y0, yd)  # labels unchanged, only features
+    # env knob respected
+    monkeypatch.setenv("TIP_SYNTH_HARDNESS", "0")
+    (xe, _), _ = synthetic.image_classification(
+        seed=3, n_train=400, n_test=10, shape=(16, 16, 1)
+    )
+    np.testing.assert_array_equal(x0, xe)
+
+
+def test_image_hard_fraction_is_calibrated_and_ambiguous():
+    """A nearest-template (≈ Bayes-for-this-generator) classifier errs at
+    ~hard_frac/2 on hardness-on data and ~0 on hardness-off data: the
+    blends are genuinely between two classes, at the calibrated rate."""
+    seed, n, shape, frac = 5, 4000, (20, 20, 1), 0.1
+
+    def nearest_template_error(x, y):
+        rng = np.random.default_rng(seed)  # same derivation as the generator
+        h, w, c = shape
+        templates = rng.uniform(0.0, 0.4, size=(10, h, w, c)).astype(np.float32)
+        for cls in range(10):
+            r = (cls * 7919) % (h - 8)
+            col = (cls * 104729) % (w - 8)
+            templates[cls, r : r + 8, col : col + 8, :] += np.float32(0.55)
+        t = templates.reshape(10, -1)
+        pred = np.argmin(
+            ((x.reshape(len(x), -1)[:, None, :] - t[None]) ** 2).sum(-1), axis=1
+        )
+        return (pred != y).mean()
+
+    (x0, y0), _ = synthetic.image_classification(
+        seed=seed, n_train=n, n_test=8, shape=shape, noise=0.05, hard_frac=0.0
+    )
+    (xh, yh), _ = synthetic.image_classification(
+        seed=seed, n_train=n, n_test=8, shape=shape, noise=0.05, hard_frac=frac
+    )
+    np.testing.assert_array_equal(y0, yh)  # labels unchanged, only features
+    assert nearest_template_error(x0, y0) < 0.01
+    # a 50/50 blend is decided by the noise -> ~half the hard samples err
+    err = nearest_template_error(xh, yh)
+    assert 0.02 < err < 0.09, err
+
+
+def _legacy_tokens(seed, n_train, n_test, maxlen=100, vocab_size=2000, num_classes=2):
+    """The pre-hardness token generator, transcribed as the byte-parity
+    oracle (like ``_legacy_images`` — determinism alone would not catch a
+    refactor changing the hardness-0 branch's rng consumption)."""
+    rng = np.random.default_rng(seed)
+
+    def make(n, rng):
+        labels = rng.integers(0, num_classes, size=n)
+        x = rng.integers(1, vocab_size, size=(n, maxlen))
+        for cls in range(num_classes):
+            idx = np.where(labels == cls)[0]
+            band_lo = 100 + cls * 300
+            mask = rng.random((idx.shape[0], maxlen)) < 0.3
+            band_tokens = rng.integers(
+                band_lo, band_lo + 300, size=(idx.shape[0], maxlen)
+            )
+            x[idx] = np.where(mask, band_tokens, x[idx])
+        return x.astype(np.int32), labels.astype(np.int64)
+
+    x_train, y_train = make(n_train, rng)
+    x_test, y_test = make(n_test, rng)
+    return (x_train, y_train), (x_test, y_test)
+
+
+def test_hardness_zero_is_byte_identical_to_pre_hardness_tokens():
+    """TIP_SYNTH_HARDNESS=0 must regenerate EXACTLY the data the resumed
+    pre-hardness studies' checkpoints were trained on."""
+    got = synthetic.token_classification(
+        seed=44, n_train=50, n_test=20, hard_frac=0.0
+    )
+    want = _legacy_tokens(44, 50, 20)
+    for (xg, yg), (xw, yw) in zip(got, want):
+        np.testing.assert_array_equal(xg, xw)
+        np.testing.assert_array_equal(yg, yw)
+    # structure sanity: class bands present (band tokens over-represented)
+    (x0a, y0a), _ = got
+    band0 = ((x0a >= 100) & (x0a < 400)).mean(axis=1)[y0a == 0]
+    assert band0.mean() > 0.25
+
+
+def test_token_hardness_mixes_bands():
+    n = 3000
+    (xh, yh), _ = synthetic.token_classification(
+        seed=7, n_train=n, n_test=8, hard_frac=0.15
+    )
+    in_b0 = ((xh >= 100) & (xh < 400)).mean(axis=1)
+    in_b1 = ((xh >= 400) & (xh < 700)).mean(axis=1)
+    own = np.where(yh == 0, in_b0, in_b1)
+    other = np.where(yh == 0, in_b1, in_b0)
+    # ambiguous rows have own-band presence well below the easy ~0.44
+    # (0.3 band + background) AND other-band presence well above background
+    ambiguous = (own < 0.35) & (other > 0.22)
+    assert 0.08 < ambiguous.mean() < 0.22
