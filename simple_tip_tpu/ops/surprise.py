@@ -507,10 +507,12 @@ class MLSA(SA):
                     reg_covar=reg_covar,
                 )
                 self.gmm.fit(activations)
-                # The jnp backend's fixed-iteration EM never raises from
-                # fit; a near-singular component only explodes later in
-                # score_samples' cholesky. Probe one row so BOTH backends
-                # surface degeneracy here, inside the escalation.
+                # Backstop probe. Both backends now surface degeneracy at
+                # fit time (the jnp backend validates its final covariances
+                # sklearn-style — ops/cluster.py _validate_fit, with a
+                # parity test pinning identical rung selection), but a one
+                # -row probe here still catches anything that slips to the
+                # scoring path, keeping the ladder airtight.
                 self.gmm.score_samples(activations[:1])
                 break
             except ValueError as e:  # includes LinAlgError

