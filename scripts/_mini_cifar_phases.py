@@ -11,15 +11,12 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from scripts.mini_env import bootstrap  # noqa: E402
+from scripts.mini_env import bootstrap, class_coverage_preflight  # noqa: E402
 
 
 def main():
     bootstrap()
-    import numpy as np
-
     from simple_tip_tpu.casestudies.mini import provide
-    from simple_tip_tpu.models.train import make_predict_fn
 
     cs_name = sys.argv[1] if len(sys.argv) > 1 else "mini-cifar10"
     workers = int(sys.argv[2]) if len(sys.argv) > 2 else 2
@@ -30,23 +27,7 @@ def main():
     cs.train(run_ids, use_mesh=False, group_size=1)
     print(f"[{cs_name}] training done in {time.time()-t0:.1f}s", flush=True)
 
-    (x_tr, _), (x_te, _), (x_ood, _) = cs.spec.loader()
-    predict = make_predict_fn(cs.scoring_model_def)
-    for rid in run_ids:
-        params = cs.load_params(rid)
-        train_classes = set(np.argmax(predict(params, x_tr), axis=1).tolist())
-        eval_classes = set(np.argmax(predict(params, x_te), axis=1).tolist())
-        eval_classes |= set(np.argmax(predict(params, x_ood), axis=1).tolist())
-        uncovered = eval_classes - train_classes
-        if uncovered:
-            raise SystemExit(
-                f"[{cs_name}] run {rid} predicts classes {sorted(uncovered)} "
-                f"on eval data but never on train data — per-class SA would "
-                f"fail (reference semantics). Delete this run's checkpoint "
-                f"under $TIP_ASSETS/models/{cs_name}/ and retrain with more "
-                f"epochs in casestudies/mini.py."
-            )
-    print(f"[{cs_name}] class-coverage preflight OK", flush=True)
+    class_coverage_preflight(cs, cs_name, run_ids)
 
     t0 = time.time()
     cs.run_prio_eval(run_ids, num_workers=workers)

@@ -28,3 +28,34 @@ def bootstrap(assets: str = "/tmp/mini_study_assets") -> None:
     # alone is silently ignored — sitecustomize pre-registers the TPU
     # plugin; and probing a dead tunnel would hang).
     jax.config.update("jax_platforms", "cpu")
+
+
+def class_coverage_preflight(cs, cs_name: str, run_ids) -> None:
+    """Catch class-degenerate runs in seconds, not 20 min into test_prio.
+
+    Per-class LSA (reference semantics, src/core/surprise.py) raises on a
+    test point whose predicted class never appears among the TRAIN
+    predictions; shared here so mini_study.py and the per-phase helpers
+    cannot drift apart (round-4 advisor finding).
+    """
+    import numpy as np
+
+    from simple_tip_tpu.models.train import make_predict_fn
+
+    (x_tr, _), (x_te, _), (x_ood, _) = cs.spec.loader()
+    predict = make_predict_fn(cs.scoring_model_def)
+    for rid in run_ids:
+        params = cs.load_params(rid)
+        train_classes = set(np.argmax(predict(params, x_tr), axis=1).tolist())
+        eval_classes = set(np.argmax(predict(params, x_te), axis=1).tolist())
+        eval_classes |= set(np.argmax(predict(params, x_ood), axis=1).tolist())
+        uncovered = eval_classes - train_classes
+        if uncovered:
+            raise SystemExit(
+                f"[{cs_name}] run {rid} predicts classes {sorted(uncovered)} "
+                f"on eval data but never on train data — per-class SA would "
+                f"fail (reference semantics). Delete this run's checkpoint "
+                f"(under $TIP_ASSETS/models/{cs_name}/) and retrain with "
+                f"more epochs in casestudies/mini.py."
+            )
+    print(f"[{cs_name}] class-coverage preflight OK", flush=True)

@@ -40,8 +40,9 @@ def main() -> int:
         type=int,
         default=2,
         help="runs that get ACTIVE-LEARNING artifacts (retraining is the "
-        "expensive CPU phase: measured ~45 s/retrain x ~80 retrains/run at "
-        "1200-sample scale on this 1-core host); the remaining runs form "
+        "expensive CPU phase: measured ~29 s/retrain x ~80 retrains/run "
+        "= ~39 min/run at the shipped 600-sample scale on this 1-core "
+        "host, runs 0-1 of mini_study_r04); the remaining runs form "
         "the demonstrated incomplete-AL gap",
     )
     ap.add_argument("--workers", type=int, default=2)
@@ -52,7 +53,7 @@ def main() -> int:
     # Shared bootstrap (scripts/mini_env.py): asset/provider env, cpu-pinned
     # same-backend workers, raised scheduler wedge timeout, and the
     # bind-cpu-before-backend-init ordering this deployment requires.
-    from scripts.mini_env import bootstrap
+    from scripts.mini_env import bootstrap, class_coverage_preflight
 
     bootstrap(args.assets)
 
@@ -69,30 +70,7 @@ def main() -> int:
         timings[f"{cs_name}/training"] = round(time.time() - t0, 1)
         print(f"[{cs_name}] training done in {timings[f'{cs_name}/training']}s", flush=True)
 
-        # Preflight: per-class LSA (reference semantics) raises on a test
-        # point whose predicted class never appears among the TRAIN
-        # predictions, so catch class-degenerate runs here (seconds) rather
-        # than 20 minutes into test_prio.
-        import numpy as np
-        from simple_tip_tpu.models.train import make_predict_fn
-
-        (x_tr, _), (x_te, _), (x_ood, _) = cs.spec.loader()
-        predict = make_predict_fn(cs.scoring_model_def)
-        for rid in run_ids:
-            params = cs.load_params(rid)
-            train_classes = set(np.argmax(predict(params, x_tr), axis=1).tolist())
-            eval_classes = set(np.argmax(predict(params, x_te), axis=1).tolist())
-            eval_classes |= set(np.argmax(predict(params, x_ood), axis=1).tolist())
-            uncovered = eval_classes - train_classes
-            if uncovered:
-                raise SystemExit(
-                    f"[{cs_name}] run {rid} predicts classes {sorted(uncovered)} "
-                    f"on eval data but never on train data — per-class SA would "
-                    f"fail (reference semantics). Delete this run's checkpoint "
-                    f"(under {os.environ['TIP_ASSETS']}/models/{cs_name}/) and "
-                    f"retrain with more epochs in casestudies/mini.py."
-                )
-        print(f"[{cs_name}] class-coverage preflight OK", flush=True)
+        class_coverage_preflight(cs, cs_name, run_ids)
 
         t0 = time.time()
         cs.run_prio_eval(run_ids, num_workers=args.workers)

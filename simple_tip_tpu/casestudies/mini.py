@@ -6,8 +6,9 @@ datasets — which a single-core host cannot push through a 10-run x
 all-phases study in useful time. These minis keep every STRUCTURAL property
 the evaluation layer depends on (10 classes, dropout vs no-dropout model
 families, nominal + corrupted-OOD eval sets, the same tap layout and
-artifact contract) at ~1/100 the compute (sized against this host's measured ~45 s/retrain
-XLA:CPU cost at 1200-sample scale — the phase the chip accelerates), so a full multi-run study —
+artifact contract) at ~1/100 the compute (the shipped 600-sample scale costs
+a measured ~29 s/retrain on XLA:CPU — ~39 min per 80-retrain AL run, the
+phase the chip accelerates; mini_study_r04 MANIFEST), so a full multi-run study —
 train → test_prio → active_learning → all four evaluations — runs
 end-to-end in minutes-per-run (scripts/mini_study.py, committed results
 under results/mini_study_r04/).

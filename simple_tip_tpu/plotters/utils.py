@@ -8,6 +8,7 @@ defines the same canon); the machinery around them — loaders, run
 bookkeeping, latex helpers — is this repo's own.
 """
 
+import copy
 import logging
 import pickle
 import re
@@ -165,9 +166,11 @@ def _load_artifact(path: Path):
 # re-unpickles thousands of small accuracy dicts. A bounded FIFO memo lets
 # the second and later sweeps skip the unpickling; an entry is invalidated
 # by any (name, size, mtime_ns) change in its hit set, so a phase writing
-# new artifacts mid-process is picked up on the next call. The unpickled
-# objects themselves are shared between hits — callers treat artifacts as
-# read-only (they aggregate, never mutate). The bound comfortably covers
+# new artifacts mid-process is picked up on the next call. Every call
+# returns a DEEP COPY of the memoized objects (round-4 advisor finding: a
+# caller mutating a loaded dict must not corrupt later sweeps — pinned by
+# tests/test_plotters.py); a deep copy of array-heavy artifacts is memcpys,
+# still far cheaper than disk + unpickle. The bound comfortably covers
 # one full sweep's distinct keys (approaches x splits) while capping RSS.
 _ARTIFACT_MEMO: "dict" = {}
 _ARTIFACT_MEMO_MAX = 256
@@ -188,13 +191,15 @@ def load_all_for_regex(research_question: str, regex: re.Pattern) -> Tuple[List,
     cached = _ARTIFACT_MEMO.get(memo_key)
     if cached is not None and cached[0] == stamp:
         contents, names = cached[1]
-        return list(contents), list(names)
+        return copy.deepcopy(contents), list(names)
     contents = [_load_artifact(p) for p in hits]
     names = [p.name for p in hits]
     while len(_ARTIFACT_MEMO) >= _ARTIFACT_MEMO_MAX:
         _ARTIFACT_MEMO.pop(next(iter(_ARTIFACT_MEMO)))
     _ARTIFACT_MEMO[memo_key] = (stamp, (contents, names))
-    return list(contents), list(names)
+    # the first caller gets a copy too: it must not be able to mutate the
+    # objects the memo just captured
+    return copy.deepcopy(contents), list(names)
 
 
 def identify_incomplete_values(

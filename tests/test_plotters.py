@@ -151,3 +151,33 @@ def test_cli_runs_parser():
     assert _parse_runs("0-3") == [0, 1, 2, 3]
     assert _parse_runs("0,5,9") == [0, 5, 9]
     assert _parse_runs("-1") == list(range(100))
+
+
+def test_artifact_memo_returns_isolated_copies(tmp_path, monkeypatch):
+    """Round-4 advisor: a caller mutating a loaded artifact must not
+    corrupt what later sweeps see — the memo's read-only contract is
+    enforced by deep copy, not by comment."""
+    import re as _re
+
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path))
+    folder = tmp_path / "active_learning"
+    folder.mkdir()
+    with open(folder / "mnist_acc_0_softmax", "wb") as f:
+        pickle.dump({"accuracies": [0.5, 0.6]}, f)
+
+    from simple_tip_tpu.plotters import utils as putils
+
+    putils._ARTIFACT_MEMO.clear()
+    pat = _re.compile(r"mnist_acc_\d+_softmax")
+    first, names = putils.load_all_for_regex("active_learning", pat)
+    assert names == ["mnist_acc_0_softmax"]
+    # hostile caller mutates both the object and the outer list
+    first[0]["accuracies"].append(999.0)
+    first[0]["injected"] = True
+    first.clear()
+    second, _ = putils.load_all_for_regex("active_learning", pat)  # memo hit
+    assert second[0] == {"accuracies": [0.5, 0.6]}
+    # and a second hit is not corrupted by mutating the first hit either
+    second[0]["accuracies"][0] = -1
+    third, _ = putils.load_all_for_regex("active_learning", pat)
+    assert third[0] == {"accuracies": [0.5, 0.6]}
