@@ -226,7 +226,9 @@ def main() -> int:
 
     study = _load_study(args.study_json)
     study.setdefault("case_study", args.case_study)
-    study.setdefault("runs_requested", args.runs)
+    # a widened re-invocation (e.g. watcher --runs 30 after the 10-run bus
+    # completed) raises the recorded target; it never shrinks
+    study["runs_requested"] = max(int(study.get("runs_requested", 0)), args.runs)
     study["platform"] = platform
     # Synthetic-hardness provenance: the stand-in generators' calibrated
     # ambiguity (TIP_SYNTH_HARDNESS, data/synthetic.py) must be IDENTICAL
@@ -340,8 +342,13 @@ def _finalize(study: dict, args) -> None:
                 "total_s": round(sum(secs), 1),
             }
     study["summary"] = summary
+    # completeness is judged against the PERSISTED target, not this
+    # invocation's --runs: after a widening pass raised runs_requested to
+    # 30, a later 10-run re-arm invocation must not flip the study back to
+    # complete at 10/30 (round-5 review finding).
+    target = max(int(study.get("runs_requested", 0)), args.runs)
     complete = all(
-        summary.get(p, {}).get("runs_ok", 0) >= args.runs
+        summary.get(p, {}).get("runs_ok", 0) >= target
         for p in ("training", "test_prio", "active_learning")
     )
     study["complete"] = complete

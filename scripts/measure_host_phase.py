@@ -69,10 +69,30 @@ def main() -> int:
         # honest scale label: reflects what the loaders actually consumed
         "data_source": data_source("mnist"),
         "synth_scale": os.environ["TIP_SYNTH_SCALE"],
+        "synth_hardness": os.environ.get("TIP_SYNTH_HARDNESS", "default"),
     }
+    # keep prior rounds' headline numbers (e.g. the r04 jax-vs-sklearn
+    # backend comparison) visible across re-measurements
+    try:
+        with open(args.out) as f:
+            prev = json.load(f)
+        record["history"] = prev.get("history", {})
+        prev_key = f"prior_capture_{prev.get('captured_unix', 'unknown')}"
+        record["history"][prev_key] = {
+            "test_prio_s": prev.get("test_prio_s"),
+            "train_1epoch_s": prev.get("train_1epoch_s"),
+        }
+    except (OSError, ValueError):
+        pass
     t0 = time.time()
     cs.train([0])
     record["train_1epoch_s"] = round(time.time() - t0, 1)
+    if record["train_1epoch_s"] < 1.0:
+        # checkpoint reuse: don't record a misleading ~0 as the train cost
+        record["train_note"] = (
+            "checkpoint reused on this invocation; see history for the "
+            "fresh 1-epoch measurement"
+        )
     print(f"train (1 epoch): {record['train_1epoch_s']}s", flush=True)
 
     t0 = time.time()
@@ -105,8 +125,10 @@ def main() -> int:
         "across worker processes (parallel/run_scheduler.py)"
     )
 
-    with open(args.out, "w") as f:
-        json.dump(record, f, indent=1)
+    record["captured_unix"] = round(time.time(), 1)
+    from simple_tip_tpu.utils.artifacts_io import atomic_write_json
+
+    atomic_write_json(args.out, record)
     print(json.dumps({k: v for k, v in record.items() if k != "times_sum_by_metric"}))
     return 0
 

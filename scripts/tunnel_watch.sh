@@ -78,8 +78,19 @@ while true; do
      && have_json_flag ATTENTION_BENCH.json complete \
      && have_json_flag MFU_BREAKDOWN.json complete \
      && [ -f bench_tpu.json ] && [ -f CAM_BENCH_DEVICE.json ]; then
-    echo "$(date -u +%FT%TZ) full chip evidence set captured; watcher exiting"
-    break
+    # Core evidence set done — opportunistically widen the r05 bus toward
+    # the reference's 100-run canon (resumable; each invocation advances
+    # whatever runs the current window allows). Note this flips STUDY5's
+    # complete flag to the 30-run target, so the branch above re-arms it;
+    # the watcher only exits once the widened bus is complete.
+    runs_now=$(python -c "import json;print(json.load(open('$STUDY5'))['runs_requested'])" 2>/dev/null || echo 10)
+    if [ "$runs_now" -ge 30 ]; then
+      echo "$(date -u +%FT%TZ) full chip evidence + 30-run bus captured; watcher exiting"
+      break
+    fi
+    echo "$(date -u +%FT%TZ) core evidence captured; widening the r05 bus to 30 runs"
+    TIP_ASSETS=/tmp/tpu_study_assets_r05 python scripts/capture_tpu_evidence.py \
+      --runs 30 --study-json "$STUDY5"
   fi
   sleep 900
 done

@@ -164,6 +164,51 @@ def main():
         "samples": 5000, "sections": 2048, "orders_identical": bool(same),
         "device_ms": round(td * 1e3, 1), "host_native_ms": round(th * 1e3, 1),
     }
+    _persist()
+
+    # -- 5: fused Pallas forward vs flax (compiled, on chip) ---------------
+    # bench.py gates this kernel at runtime anyway; validating here too
+    # gives the per-round evidence record a compiled-numerics entry and a
+    # first on-chip timing at bench shapes.
+    try:
+        from simple_tip_tpu.models import MnistConvNet
+        from simple_tip_tpu.models.train import init_params
+        from simple_tip_tpu.ops.fused_forward import (
+            fused_mnist_probs,
+            validate_against_model,
+        )
+
+        params = init_params(
+            MnistConvNet(), jax.random.PRNGKey(0),
+            np.zeros((1, 28, 28, 1), np.float32),
+        )
+        gap = validate_against_model(params, jnp.bfloat16, n=512)
+        xb = jnp.asarray(
+            rng.normal(size=(8192, 28, 28, 1)).astype(np.float32)
+        )
+        fused_fn = jax.jit(
+            lambda p, x: fused_mnist_probs(p, x, jnp.bfloat16)
+        )
+        model = MnistConvNet(compute_dtype="bfloat16")
+        flax_fn = jax.jit(
+            lambda p, x: model.apply({"params": p}, x, train=False)[0]
+        )
+        tf_, _ = _fetch_time(lambda: fused_fn(params, xb))
+        tx_, _ = _fetch_time(lambda: flax_fn(params, xb))
+        ok = gap < 5e-3
+        failures += not ok
+        print(
+            f"fused forward: max prob gap {gap:.2e} {'OK' if ok else 'FAIL'} | "
+            f"fused {tf_*1e3:.1f} ms vs xla {tx_*1e3:.1f} ms at batch 8192"
+        )
+        record["fused_forward"] = {
+            "max_prob_gap": float(gap), "ok": bool(ok), "batch": 8192,
+            "fused_ms": round(tf_ * 1e3, 2), "xla_ms": round(tx_ * 1e3, 2),
+        }
+    except Exception as e:  # noqa: BLE001 — a lowering failure is evidence
+        failures += 1
+        print(f"fused forward FAILED to run: {e!r}")
+        record["fused_forward"] = {"error": repr(e)[:300], "ok": False}
 
     record["failures"] = failures
     record["complete"] = True

@@ -1,0 +1,203 @@
+"""Fully-fused Pallas forward for the MNIST/FMNIST convnet scoring path.
+
+Why this kernel exists (SCALING.md "Where the 92% goes"): the flagship
+TIP-scoring path is HBM-bound — its arithmetic intensity is 32.8 flop/byte
+against the chip's 241 flop/byte balance point, because XLA materializes
+every layer's activations to HBM at batch 32k (the analytic mandatory
+traffic, `utils.flops.conv_net_forward_hbm_bytes`, is ~149 KB/input and
+the measured rate already runs at 58% of HBM peak). This kernel runs the
+ENTIRE forward — conv1 → pool → conv2 → pool → dense → softmax — for a
+batch tile inside VMEM, so per-input HBM traffic collapses to the input
+read + 10 probabilities out (~3.2 KB): intensity rises ~45×, moving the
+path from the memory roofline onto the MXU one.
+
+Kernel structure per batch tile (shapes for the 28×28×1 MNIST stack,
+reference architecture src/dnn_test_prio/case_study_mnist.py:50-69,
+mirrored from models/convnet.py MnistConvNet):
+
+- conv1 (C_in=1) as 9 shifted broadcast FMAs — its FLOPs are 8% of the
+  model; an im2col matmul with K=9 would waste the 128-wide MXU anyway.
+- maxpool 2×2 via reshape-max (26 = 2·13 exactly).
+- conv2 as ONE im2col matmul ``[TB·121, 288] @ [288, 64]`` — the FLOPs
+  center of the model (58%); K=288 keeps the MXU's contraction dimension
+  full, where the 9-shift formulation's K=32 would cap it at a quarter.
+  The patch concatenation order (dy-major, then dx, then channel) matches
+  ``w2.reshape(288, 64)`` row order.
+- pool 2×2 on 11×11 floors to 5×5 (slice ``[:10, :10]`` then reshape-max,
+  equal to flax ``max_pool`` window-2 stride-2 semantics).
+- dense ``[TB, 1600] @ [1600, 10]`` (+bias) in one matmul; softmax f32.
+
+``compute_dtype=bfloat16`` feeds the matmuls bf16 operands with f32
+accumulation (``preferred_element_type``), the same contract as the flax
+model's bf16 mode; f32 is exact-parity mode. Inference only (dropout
+inactive), probabilities out — the scoring hot path of the reference's
+``handler_model.py:102-173``; uncertainty quantifiers stay outside (they
+are elementwise on [B, 10] — XLA fuses them into the consumer for free).
+
+Correctness is pinned against the flax model in interpret mode on CPU
+(tests/test_fused_forward.py); bench.py auto-validates numerics at runtime
+before trusting the kernel on real hardware (TIP_BENCH_FUSED knob), so a
+Mosaic lowering quirk on some TPU generation can never silently corrupt a
+benchmark record.
+"""
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # pallas is optional at import time (matches ops/flash_attention.py)
+    from jax.experimental import pallas as pl
+
+    HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    pl = None
+    HAVE_PALLAS = False
+
+
+def _mnist_kernel(
+    x_ref, w1_ref, b1_ref, w2_ref, b2_ref, wd_ref, bd_ref, out_ref, *, cdt
+):
+    f32 = jnp.float32
+    x = x_ref[...].astype(cdt)  # [TB, 28, 28, 1]
+    tb = x.shape[0]
+
+    # conv1: C_in=1 -> 9 shifted broadcast FMAs, f32 accumulator
+    w1 = w1_ref[...].astype(cdt)  # [3, 3, 1, 32]
+    acc = jnp.zeros((tb, 26, 26, 32), f32)
+    for dy in range(3):
+        for dx in range(3):
+            acc = acc + (
+                x[:, dy : dy + 26, dx : dx + 26, :] * w1[dy, dx, 0, :]
+            ).astype(f32)
+    h = jax.nn.relu(acc + b1_ref[...].astype(f32))  # [TB, 26, 26, 32]
+    # pool 2x2 (26 = 2*13)
+    h = jnp.max(h.reshape(tb, 13, 2, 13, 2, 32), axis=(2, 4))  # [TB,13,13,32]
+
+    # conv2: one im2col matmul [TB*121, 288] @ [288, 64]
+    h = h.astype(cdt)
+    patches = jnp.concatenate(
+        [
+            h[:, dy : dy + 11, dx : dx + 11, :]
+            for dy in range(3)
+            for dx in range(3)
+        ],
+        axis=-1,
+    )  # [TB, 11, 11, 288] in (dy, dx, c) channel order
+    w2 = w2_ref[...].astype(cdt).reshape(288, 64)  # same (dy, dx, c) rows
+    h2 = jax.lax.dot_general(
+        patches.reshape(tb * 121, 288),
+        w2,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=f32,
+    ).reshape(tb, 11, 11, 64)
+    h2 = jax.nn.relu(h2 + b2_ref[...].astype(f32))
+    # pool 2x2 on 11x11 -> 5x5 (floor semantics == slice even region)
+    h2 = jnp.max(
+        h2[:, :10, :10, :].reshape(tb, 5, 2, 5, 2, 64), axis=(2, 4)
+    )  # [TB, 5, 5, 64]
+
+    # dense + softmax (f32)
+    flat = h2.reshape(tb, 1600).astype(cdt)
+    logits = (
+        jax.lax.dot_general(
+            flat,
+            wd_ref[...].astype(cdt),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=f32,
+        )
+        + bd_ref[...].astype(f32)
+    )
+    out_ref[...] = jax.nn.softmax(logits, axis=-1)
+
+
+def fused_mnist_probs(
+    params: dict,
+    x: jnp.ndarray,
+    compute_dtype: Optional[Any] = jnp.bfloat16,
+    tile: int = 64,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Softmax probabilities [B, 10] for MnistConvNet via the fused kernel.
+
+    ``params``: the flax param tree of ``MnistConvNet`` (``Conv_0``,
+    ``Conv_1``, ``Dense_0``). Batch is padded to a multiple of ``tile``
+    internally. Wrap in ``jax.jit`` at the call site.
+    """
+    if not HAVE_PALLAS:
+        raise RuntimeError("jax.experimental.pallas unavailable in this build")
+    cdt = jnp.dtype(compute_dtype) if compute_dtype is not None else jnp.dtype(
+        jnp.float32
+    )
+    w1 = params["Conv_0"]["kernel"]
+    b1 = params["Conv_0"]["bias"]
+    w2 = params["Conv_1"]["kernel"]
+    b2 = params["Conv_1"]["bias"]
+    wd = params["Dense_0"]["kernel"]
+    bd = params["Dense_0"]["bias"]
+    assert w1.shape == (3, 3, 1, 32) and w2.shape == (3, 3, 32, 64), (
+        "fused kernel mirrors the MNIST/FMNIST architecture only"
+    )
+    b = x.shape[0]
+    pad = (-b) % tile
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0
+        )
+    n_tiles = x.shape[0] // tile
+
+    full = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+    out = pl.pallas_call(
+        functools.partial(_mnist_kernel, cdt=cdt),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile, 28, 28, 1), lambda i: (i, 0, 0, 0)),
+            full(w1.shape),
+            full(b1.shape),
+            full(w2.shape),
+            full(b2.shape),
+            full(wd.shape),
+            full(bd.shape),
+        ],
+        out_specs=pl.BlockSpec((tile, 10), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((x.shape[0], 10), jnp.float32),
+        interpret=interpret,
+    )(x, w1, b1, w2, b2, wd, bd)
+    return out[:b]
+
+
+def fused_available() -> bool:
+    return HAVE_PALLAS
+
+
+def validate_against_model(
+    params: dict,
+    compute_dtype: Optional[Any] = jnp.bfloat16,
+    n: int = 256,
+    tile: int = 64,
+    interpret: bool = False,
+    seed: int = 0,
+) -> float:
+    """Max |fused - flax| probability gap on random inputs (runtime gate).
+
+    bench.py refuses the fused path unless this is small; the flax model
+    runs in the SAME compute dtype, so the gap measures kernel-vs-XLA
+    numerics, not bf16-vs-f32 rounding. ``tile`` must be the tile the
+    caller will MEASURE with — lowering is tile-dependent, so validating
+    one tile says nothing about another.
+    """
+    from simple_tip_tpu.models import MnistConvNet
+
+    x = jnp.asarray(
+        np.random.default_rng(seed).normal(size=(n, 28, 28, 1)).astype(np.float32)
+    )
+    model = MnistConvNet(
+        compute_dtype=None
+        if compute_dtype is None or jnp.dtype(compute_dtype) == jnp.float32
+        else compute_dtype
+    )
+    ref_probs, _ = model.apply({"params": params}, x, train=False)
+    got = fused_mnist_probs(params, x, compute_dtype, tile=tile, interpret=interpret)
+    return float(jnp.max(jnp.abs(got - ref_probs)))

@@ -22,6 +22,7 @@ quirk); here every stochastic fit takes an explicit ``seed`` defaulting to 0.
 import abc
 import logging
 import math
+import os
 import warnings
 from typing import Callable, Dict, Iterable, List, Optional, Tuple, Union
 
@@ -185,21 +186,42 @@ class _KmeansDiscriminator:
         max_iter: int = 300,
         seed: Optional[int] = 0,
     ):
-        KMeans, silhouette_score, _ = _cluster_backend()
+        KMeans, backend_silhouette, _ = _cluster_backend()
+        from simple_tip_tpu.ops.cluster import silhouette_scores_multi
 
         training_data = _flatten_layers(training_data)
         training_data = _subsample_array(
             subsampling, training_data, seed=subsampling_seed
         )
-        self.best_score = -np.inf
-        self.best_k = None
-        self.best_clusterer = None
+        # Fit every candidate k first, THEN score all labelings in one
+        # shared-distance silhouette pass: the O(n²·d) pairwise work does
+        # not depend on labels, so the reference's per-k silhouette loop
+        # (src/core/surprise.py:102-133) pays it |potential_k| times for
+        # nothing. Selection semantics are unchanged (same argmax, ties to
+        # the smaller k); f32-silhouette parity vs sklearn is pinned by
+        # tests/test_cluster.py. An EXPLICIT TIP_CLUSTER_BACKEND=sklearn
+        # keeps sklearn's own f64 silhouette per k — the "force one side"
+        # contract (_cluster_backend docstring) outranks the speedup.
+        fitted = []
         for i in potential_k:
             kmeans = KMeans(
                 n_clusters=i, n_init=n_init, max_iter=max_iter, random_state=seed
             )
-            cluster_labels = kmeans.fit_predict(training_data)
-            silhouette_avg = silhouette_score(training_data, cluster_labels)
+            fitted.append((i, kmeans, kmeans.fit_predict(training_data)))
+        forced = os.environ.get("TIP_CLUSTER_BACKEND", "auto").strip().lower()
+        if forced == "sklearn":
+            scores = [
+                backend_silhouette(training_data, labels)
+                for _, _, labels in fitted
+            ]
+        else:
+            scores = silhouette_scores_multi(
+                training_data, [labels for _, _, labels in fitted]
+            )
+        self.best_score = -np.inf
+        self.best_k = None
+        self.best_clusterer = None
+        for (i, kmeans, _), silhouette_avg in zip(fitted, scores):
             if silhouette_avg > self.best_score:
                 self.best_score = silhouette_avg
                 self.best_k = i
@@ -375,12 +397,26 @@ class MDSA(SA):
     def __init__(self, activations: Activations):
         import scipy.linalg
 
-        activations = _flatten_layers(activations).astype(np.float64)
-        self.location = activations.mean(axis=0)
+        # f32 accumulation for the O(n·d²) covariance GEMM (sgemm, 2× the
+        # f64 rate on this host; MXU-native on device) — mean-centering
+        # first keeps the f32 sums well-conditioned. The O(d³) pseudo-
+        # inverse stays f64: it is the numerically delicate step and is
+        # cheap relative to the GEMMs. Parity coverage: exact ordering +
+        # rtol 2e-3 vs the reference's all-f64 sklearn path at small
+        # shapes (tests/test_reference_oracle.py), and near-perfect rank
+        # agreement vs a transcribed f64 oracle at thousands×hundreds
+        # (tests/test_surprise.py::test_mdsa_f32_ordering_parity_at_scale)
+        # — f32 can still swap scores tied within ~1e-4 relative.
+        activations = _flatten_layers(activations).astype(np.float32)
+        self.location = activations.mean(axis=0, dtype=np.float64).astype(
+            np.float32
+        )
         # ML (biased) covariance — matches sklearn EmpiricalCovariance.
         centered = activations - self.location
-        self.covariance = centered.T @ centered / activations.shape[0]
-        self.precision = scipy.linalg.pinvh(np.atleast_2d(self.covariance))
+        self.covariance = (centered.T @ centered).astype(np.float64) / activations.shape[0]
+        self.precision = scipy.linalg.pinvh(np.atleast_2d(self.covariance)).astype(
+            np.float32
+        )
 
     def __call__(
         self,
@@ -388,11 +424,15 @@ class MDSA(SA):
         predictions: Predictions = None,
         num_threads: int = None,
     ) -> np.ndarray:
-        activations = _flatten_layers(activations).astype(np.float64)
+        activations = _flatten_layers(activations).astype(np.float32)
         centered = activations - self.location
         # one BLAS gemm + a row-wise dot; the 3-operand einsum form takes
-        # numpy's unoptimized path and was ~5x slower
-        return np.einsum("ij,ij->i", centered @ self.precision, centered)
+        # numpy's unoptimized path and was ~5x slower. f64 row reduction
+        # over f32 gemm outputs: the final dot's additions are where
+        # cancellation could reorder near-ties.
+        return np.einsum(
+            "ij,ij->i", (centered @ self.precision).astype(np.float64), centered
+        )
 
 
 class LSA(SA):

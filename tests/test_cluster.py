@@ -142,3 +142,40 @@ def test_gmm_degeneracy_detected_at_fit_like_sklearn():
     assert accepted_rung(SkGMM) is not None  # sanity on the scan helper
     jb = JGMM(n_components=3, reg_covar=1e-6, random_state=0).fit(xb)
     assert np.all(np.isfinite(jb.score_samples(xb[:1])))
+
+
+def test_silhouette_multi_matches_sklearn_and_single():
+    """Parity gate for the shared-distance-pass silhouette (round-4
+    verdict, weak #5): values match sklearn within f32 tolerance, the
+    multi-labeling path equals the single path exactly, and the k
+    SELECTED by a discriminator sweep is sklearn's."""
+    from sklearn.cluster import KMeans as SkKMeans
+    from sklearn.metrics import silhouette_score as sk_sil
+
+    from simple_tip_tpu.ops.cluster import (
+        silhouette_score,
+        silhouette_scores_multi,
+    )
+
+    rng = np.random.default_rng(3)
+    x = (rng.normal(size=(900, 24)) + rng.integers(0, 3, size=900)[:, None] * 2.5
+         ).astype(np.float32)
+    labelings, sk_scores = [], []
+    for k in range(2, 6):
+        lab = SkKMeans(k, n_init=10, random_state=0).fit_predict(x)
+        labelings.append(lab)
+        sk_scores.append(sk_sil(x, lab))
+    ours = silhouette_scores_multi(x, labelings)
+    for got, want in zip(ours, sk_scores):
+        assert abs(got - want) < 2e-4, (got, want)
+    # same k selected
+    assert int(np.argmax(ours)) == int(np.argmax(sk_scores))
+    # multi == single (same code path contract)
+    for lab, got in zip(labelings, ours):
+        assert silhouette_score(x, lab) == got
+    # singleton-cluster handling matches sklearn (s=0 for singletons)
+    lab = np.zeros(900, dtype=np.int64)
+    lab[0] = 1
+    lab[1:450] = 2
+    got = silhouette_scores_multi(x, [lab])[0]
+    assert abs(got - sk_sil(x, lab)) < 2e-4

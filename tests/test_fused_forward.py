@@ -1,0 +1,66 @@
+"""Fused Pallas MNIST forward vs the flax model (interpret mode on CPU).
+
+The kernel collapses per-input HBM traffic by keeping all activations in
+VMEM (SCALING.md roofline section); these tests pin its NUMERICS to the
+flax model — same compute dtype on both sides, so tolerances measure
+kernel-vs-XLA arithmetic, not precision modes. Reference scoring path:
+src/dnn_test_prio/handler_model.py:102-173."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from simple_tip_tpu.models import MnistConvNet  # noqa: E402
+from simple_tip_tpu.models.train import init_params  # noqa: E402
+from simple_tip_tpu.ops import fused_forward  # noqa: E402
+
+if not fused_forward.fused_available():  # pragma: no cover
+    pytest.skip("pallas unavailable", allow_module_level=True)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(
+        MnistConvNet(), jax.random.PRNGKey(0), np.zeros((1, 28, 28, 1), np.float32)
+    )
+
+
+def test_fused_matches_flax_f32(params):
+    gap = fused_forward.validate_against_model(
+        params, compute_dtype=None, n=96, interpret=True
+    )
+    assert gap < 1e-5, gap
+
+
+def test_fused_matches_flax_bf16(params):
+    # both sides bf16: residual gap is op-ordering only (im2col matmul vs
+    # XLA conv), well under bf16 epsilon on softmax outputs
+    gap = fused_forward.validate_against_model(
+        params, compute_dtype=jnp.bfloat16, n=96, interpret=True
+    )
+    assert gap < 5e-3, gap
+
+
+def test_fused_pads_ragged_batch(params):
+    x = jnp.asarray(
+        np.random.default_rng(1).normal(size=(70, 28, 28, 1)).astype(np.float32)
+    )
+    probs, _ = MnistConvNet().apply({"params": params}, x, train=False)
+    got = fused_forward.fused_mnist_probs(
+        params, x, compute_dtype=None, tile=64, interpret=True
+    )
+    assert got.shape == (70, 10)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(probs), atol=1e-5)
+
+
+def test_fused_probs_are_distributions(params):
+    x = jnp.asarray(
+        np.random.default_rng(2).normal(size=(64, 28, 28, 1)).astype(np.float32)
+    )
+    got = np.asarray(
+        fused_forward.fused_mnist_probs(params, x, jnp.bfloat16, interpret=True)
+    )
+    np.testing.assert_allclose(got.sum(axis=1), 1.0, atol=1e-3)
+    assert (got >= 0).all()
