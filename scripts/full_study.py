@@ -142,20 +142,30 @@ def main() -> int:
 
     for phase in phases:
         if phase == "evaluation":
-            if jax.process_count() > 1:
-                # Aggregation reads every host's artifacts off the shared
-                # filesystem — wait for all hosts to finish writing first.
-                from jax.experimental import multihost_utils
+            # Aggregation reads every host's artifacts off the shared
+            # filesystem — wait for all hosts to finish writing first.
+            # distributed.barrier is a coordination-service rendezvous, NOT
+            # a device collective: phase skew between hosts is minutes
+            # here, which Gloo's 30 s lazy-init key exchange cannot
+            # survive (round-4 flaky-under-contention postmortem).
+            # Timeout scales with the work the slowest host may still be
+            # doing: pre-evaluation skew is bounded by the per-host run
+            # shard; post-evaluation, host 0 aggregates ALL hosts'
+            # artifacts. A fixed fuse shorter than that would recreate the
+            # end-of-run crash this barrier exists to prevent.
+            sync_budget_s = max(3600.0, 120.0 * len(all_runs) * len(case_studies))
+            distributed.barrier("full_study_pre_evaluation", timeout_s=sync_budget_s)
+            if jax.process_index() == 0:
+                from simple_tip_tpu.cli import EVALS, _run_eval
 
-                multihost_utils.sync_global_devices("full_study_pre_evaluation")
-            if jax.process_index() != 0:
-                continue
-            from simple_tip_tpu.cli import EVALS, _run_eval
-
-            for which in EVALS:
-                t0 = time.perf_counter()
-                _run_eval(which, case_studies=case_studies)
-                print(f"[evaluation:{which}] {time.perf_counter() - t0:.0f}s")
+                for which in EVALS:
+                    t0 = time.perf_counter()
+                    _run_eval(which, case_studies=case_studies)
+                    print(f"[evaluation:{which}] {time.perf_counter() - t0:.0f}s")
+            # Hold every host until aggregation is done, so all processes
+            # reach jax.distributed's shutdown barrier together instead of
+            # the non-aggregating hosts timing it out while host 0 works.
+            distributed.barrier("full_study_post_evaluation", timeout_s=sync_budget_s)
             continue
         if not my_runs:  # more hosts than runs: nothing to do here
             continue

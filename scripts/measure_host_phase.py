@@ -73,6 +73,7 @@ def main() -> int:
     }
     # keep prior rounds' headline numbers (e.g. the r04 jax-vs-sklearn
     # backend comparison) visible across re-measurements
+    prev = None
     try:
         with open(args.out) as f:
             prev = json.load(f)
@@ -86,13 +87,35 @@ def main() -> int:
         pass
     t0 = time.time()
     cs.train([0])
-    record["train_1epoch_s"] = round(time.time() - t0, 1)
-    if record["train_1epoch_s"] < 1.0:
-        # checkpoint reuse: don't record a misleading ~0 as the train cost
-        record["train_note"] = (
-            "checkpoint reused on this invocation; see history for the "
-            "fresh 1-epoch measurement"
-        )
+    train_s = round(time.time() - t0, 1)
+    if train_s < 1.0:
+        # Checkpoint reuse: the skip time is NOT the train cost. Carry the
+        # fresh measurement forward from the previous record so reruns
+        # never clobber the real number (round-5 review finding).
+        prior = None
+        if isinstance(prev, dict):
+            cand = prev.get("train_1epoch_s")
+            if isinstance(cand, (int, float)) and cand >= 1.0:
+                prior = float(cand)
+            else:
+                for h in (prev.get("history") or {}).values():
+                    cand = h.get("train_1epoch_s")
+                    if isinstance(cand, (int, float)) and cand >= 1.0:
+                        prior = float(cand)
+        if prior is not None:
+            record["train_1epoch_s"] = prior
+            record["train_note"] = (
+                "checkpoint reused on this invocation; value carried "
+                "forward from the same assets' fresh 1-epoch measurement"
+            )
+        else:
+            record["train_1epoch_s"] = train_s
+            record["train_note"] = (
+                "checkpoint reused and no prior fresh measurement found; "
+                "value is the skip time, not a training cost"
+            )
+    else:
+        record["train_1epoch_s"] = train_s
     print(f"train (1 epoch): {record['train_1epoch_s']}s", flush=True)
 
     t0 = time.time()

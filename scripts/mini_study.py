@@ -61,6 +61,7 @@ def main() -> int:
 
     run_ids = list(range(args.runs))
     timings = {}
+    fault_rates = {}
     for cs_name in CASE_STUDIES:
         cs = provide(cs_name)
         t0 = time.time()
@@ -71,6 +72,31 @@ def main() -> int:
         print(f"[{cs_name}] training done in {timings[f'{cs_name}/training']}s", flush=True)
 
         class_coverage_preflight(cs, cs_name, run_ids)
+
+        # Nominal fault rate (round-4 verdict, missing #3): with the
+        # calibrated-hardness stand-ins the trained models must misclassify
+        # a realistic few percent of nominal inputs — record the measured
+        # rate in the manifest so the populated nominal-APFD columns carry
+        # their provenance. One forward over the nominal test set per run;
+        # recorded in its own manifest section (NOT timings).
+        import numpy as np
+        from simple_tip_tpu.models.train import make_predict_fn
+
+        (_, _), (x_te, y_te), _ = cs.spec.loader()
+        predict = make_predict_fn(cs.scoring_model_def)
+        rates = []
+        for rid in run_ids:
+            pred = np.argmax(predict(cs.load_params(rid), x_te), axis=1)
+            rates.append(float((pred != y_te).mean()))
+        fault_rates[cs_name] = {
+            "nominal_fault_rate_mean": round(float(np.mean(rates)), 4),
+            "runs": len(rates),
+        }
+        print(
+            f"[{cs_name}] nominal fault rate over {len(rates)} runs: "
+            f"{np.mean(rates):.3%}",
+            flush=True,
+        )
 
         t0 = time.time()
         cs.run_prio_eval(run_ids, num_workers=args.workers)
@@ -141,6 +167,8 @@ def main() -> int:
         "case_studies": list(CASE_STUDIES),
         "runs": args.runs,
         "workers": args.workers,
+        "synth_hardness": os.environ.get("TIP_SYNTH_HARDNESS", "default(0.08)"),
+        "nominal_fault_rates": fault_rates,
         "al_gap": (
             f"runs {args.al_runs}-{args.runs - 1} have no AL artifacts "
             "(intentional incomplete-run demonstration; AL retraining is "

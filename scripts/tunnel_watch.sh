@@ -67,10 +67,14 @@ while true; do
   fi
   # round-5 hardened-stand-in study: advance it every cycle (cpu-pinned
   # test_prio progresses even with the tunnel down once training exists;
-  # its own per-run probes defer tunnel-bound phases)
+  # its own per-run probes defer tunnel-bound phases). --runs follows the
+  # study's PERSISTED target so a partially-widened 30-run bus keeps
+  # advancing past run 9 (round-5 review: a hard-coded 10 here livelocked
+  # the widening).
   if ! have_json_flag "$STUDY5" complete; then
+    runs_target=$(python -c "import json;print(max(10,int(json.load(open('$STUDY5')).get('runs_requested',10))))" 2>/dev/null || echo 10)
     TIP_ASSETS=/tmp/tpu_study_assets_r05 python scripts/capture_tpu_evidence.py \
-      --runs 10 --study-json "$STUDY5"
+      --runs "$runs_target" --study-json "$STUDY5"
   fi
   if have_json_flag "$STUDY" complete \
      && have_json_flag "$STUDY5" complete \

@@ -47,6 +47,40 @@ def initialize(
     )
 
 
+def barrier(name: str, timeout_s: float = 1800.0) -> None:
+    """Cross-process rendezvous via the coordination service.
+
+    Deliberately NOT ``multihost_utils.sync_global_devices``: that runs a
+    device collective, which on CPU backends lazily initializes a Gloo
+    context whose key exchange has a fixed ~30 s timeout — when one host
+    reaches the sync minutes before another (phase skew is the NORM here:
+    hosts carry different run-id shards and the evaluation phase runs on
+    process 0 only), Gloo init dies with DEADLINE_EXCEEDED and poisons the
+    whole cluster (observed as the round-4 flaky-under-contention
+    failure). A barrier is pure control flow; the coordination service's
+    ``wait_at_barrier`` does exactly that with an explicit, generous
+    timeout and no data plane.
+
+    No-op in single-process runs. Falls back to ``sync_global_devices`` if
+    the internal client API is unavailable in some jax version.
+    """
+    if not jax.distributed.is_initialized() or jax.process_count() <= 1:
+        return
+    try:
+        from jax._src import distributed as _dist
+
+        client = _dist.global_state.client
+        if client is None:
+            raise AttributeError("no distributed client")
+        client.wait_at_barrier(name, timeout_in_ms=int(timeout_s * 1000))
+        return
+    except (ImportError, AttributeError, TypeError):
+        # jax internals moved/renamed: degrade to the collective
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def global_ensemble_mesh(n_data: int = 1):
     """(ensemble, data) mesh over all global devices (multi-host aware)."""
     from simple_tip_tpu.parallel.ensemble import ensemble_mesh
