@@ -166,49 +166,62 @@ def main():
     }
     _persist()
 
-    # -- 5: fused Pallas forward vs flax (compiled, on chip) ---------------
-    # bench.py gates this kernel at runtime anyway; validating here too
-    # gives the per-round evidence record a compiled-numerics entry and a
-    # first on-chip timing at bench shapes.
-    try:
-        from simple_tip_tpu.models import MnistConvNet
-        from simple_tip_tpu.models.train import init_params
-        from simple_tip_tpu.ops.fused_forward import (
-            fused_mnist_probs,
-            validate_against_model,
-        )
+    # -- 5: fused Pallas forwards vs flax (compiled, on chip) --------------
+    # bench.py gates the mnist kernel at runtime anyway; validating BOTH
+    # families here gives the per-round evidence record compiled-numerics
+    # entries and first on-chip timings at bench shapes.
+    from simple_tip_tpu.models import Cifar10ConvNet, MnistConvNet
+    from simple_tip_tpu.models.train import init_params
+    from simple_tip_tpu.ops.fused_forward import (
+        fused_cifar10_probs,
+        fused_mnist_probs,
+        validate_against_model,
+    )
 
-        params = init_params(
-            MnistConvNet(), jax.random.PRNGKey(0),
-            np.zeros((1, 28, 28, 1), np.float32),
-        )
-        gap = validate_against_model(params, jnp.bfloat16, n=512)
-        xb = jnp.asarray(
-            rng.normal(size=(8192, 28, 28, 1)).astype(np.float32)
-        )
-        fused_fn = jax.jit(
-            lambda p, x: fused_mnist_probs(p, x, jnp.bfloat16)
-        )
-        model = MnistConvNet(compute_dtype="bfloat16")
-        flax_fn = jax.jit(
-            lambda p, x: model.apply({"params": p}, x, train=False)[0]
-        )
-        tf_, _ = _fetch_time(lambda: fused_fn(params, xb))
-        tx_, _ = _fetch_time(lambda: flax_fn(params, xb))
-        ok = gap < 5e-3
-        failures += not ok
-        print(
-            f"fused forward: max prob gap {gap:.2e} {'OK' if ok else 'FAIL'} | "
-            f"fused {tf_*1e3:.1f} ms vs xla {tx_*1e3:.1f} ms at batch 8192"
-        )
-        record["fused_forward"] = {
-            "max_prob_gap": float(gap), "ok": bool(ok), "batch": 8192,
-            "fused_ms": round(tf_ * 1e3, 2), "xla_ms": round(tx_ * 1e3, 2),
-        }
-    except Exception as e:  # noqa: BLE001 — a lowering failure is evidence
-        failures += 1
-        print(f"fused forward FAILED to run: {e!r}")
-        record["fused_forward"] = {"error": repr(e)[:300], "ok": False}
+    record["fused_forward"] = {}
+    for family, Model, shape, fused_fn, tile in (
+        ("mnist", MnistConvNet, (28, 28, 1), fused_mnist_probs, 64),
+        ("cifar10", Cifar10ConvNet, (32, 32, 3), fused_cifar10_probs, 32),
+    ):
+        try:
+            params = init_params(
+                Model(), jax.random.PRNGKey(0),
+                np.zeros((1,) + shape, np.float32),
+            )
+            gap = validate_against_model(
+                params, jnp.bfloat16, n=512, tile=tile, family=family
+            )
+            xb = jnp.asarray(
+                rng.normal(size=(8192,) + shape).astype(np.float32)
+            )
+            fused_c = jax.jit(
+                lambda p, x, f=fused_fn, t=tile: f(p, x, jnp.bfloat16, tile=t)
+            )
+            model = Model(compute_dtype="bfloat16")
+            flax_fn = jax.jit(
+                lambda p, x, m=model: m.apply({"params": p}, x, train=False)[0]
+            )
+            tf_, _ = _fetch_time(lambda: fused_c(params, xb))
+            tx_, _ = _fetch_time(lambda: flax_fn(params, xb))
+            ok = gap < 5e-3
+            failures += not ok
+            print(
+                f"fused {family}: max prob gap {gap:.2e} "
+                f"{'OK' if ok else 'FAIL'} | fused {tf_*1e3:.1f} ms vs "
+                f"xla {tx_*1e3:.1f} ms at batch 8192"
+            )
+            record["fused_forward"][family] = {
+                "max_prob_gap": float(gap), "ok": bool(ok), "batch": 8192,
+                "tile": tile,
+                "fused_ms": round(tf_ * 1e3, 2), "xla_ms": round(tx_ * 1e3, 2),
+            }
+        except Exception as e:  # noqa: BLE001 — a lowering failure is evidence
+            failures += 1
+            print(f"fused {family} FAILED to run: {e!r}")
+            record["fused_forward"][family] = {
+                "error": repr(e)[:300], "ok": False
+            }
+        _persist()
 
     record["failures"] = failures
     record["complete"] = True

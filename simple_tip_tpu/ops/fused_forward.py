@@ -113,6 +113,129 @@ def _mnist_kernel(
     out_ref[...] = jax.nn.softmax(logits, axis=-1)
 
 
+def _im2col_conv(h, w, cdt, out_hw):
+    """VALID 3×3 conv as ONE im2col matmul: [TB·out², 9·C_in] @ [9·C_in, C_out].
+
+    Patch channel order is (dy, dx, c) — exactly ``w.reshape(9·C_in, C_out)``
+    row order for a [3, 3, C_in, C_out] kernel. f32 accumulation via
+    ``preferred_element_type``.
+    """
+    tb = h.shape[0]
+    c_in, c_out = w.shape[2], w.shape[3]
+    patches = jnp.concatenate(
+        [
+            h[:, dy : dy + out_hw, dx : dx + out_hw, :]
+            for dy in range(3)
+            for dx in range(3)
+        ],
+        axis=-1,
+    )  # [TB, out, out, 9*C_in]
+    out = jax.lax.dot_general(
+        patches.reshape(tb * out_hw * out_hw, 9 * c_in),
+        w.astype(cdt).reshape(9 * c_in, c_out),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(tb, out_hw, out_hw, c_out)
+
+
+def _pool2(h, out_hw):
+    """2×2 stride-2 maxpool with flax floor semantics."""
+    tb, c = h.shape[0], h.shape[3]
+    return jnp.max(
+        h[:, : 2 * out_hw, : 2 * out_hw, :].reshape(tb, out_hw, 2, out_hw, 2, c),
+        axis=(2, 4),
+    )
+
+
+def _cifar_kernel(
+    x_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref, b3_ref,
+    wd1_ref, bd1_ref, wd2_ref, bd2_ref, out_ref, *, cdt,
+):
+    """Cifar10ConvNet forward per batch tile (models/convnet.py layer
+    order; reference src/dnn_test_prio/case_study_cifar10.py:33-57):
+    conv32 → pool → conv64 → pool → conv64 → dense64 relu → dense10
+    softmax, all three convs as im2col matmuls."""
+    f32 = jnp.float32
+    x = x_ref[...].astype(cdt)  # [TB, 32, 32, 3]
+    tb = x.shape[0]
+    h = jax.nn.relu(
+        _im2col_conv(x, w1_ref[...], cdt, 30) + b1_ref[...].astype(f32)
+    )
+    h = _pool2(h, 15).astype(cdt)  # [TB, 15, 15, 32]
+    h = jax.nn.relu(
+        _im2col_conv(h, w2_ref[...], cdt, 13) + b2_ref[...].astype(f32)
+    )
+    h = _pool2(h, 6).astype(cdt)  # [TB, 6, 6, 64] (13 floors to 6)
+    h = jax.nn.relu(
+        _im2col_conv(h, w3_ref[...], cdt, 4) + b3_ref[...].astype(f32)
+    )  # [TB, 4, 4, 64]
+    flat = h.astype(cdt).reshape(tb, 1024)
+    hd = jax.nn.relu(
+        jax.lax.dot_general(
+            flat, wd1_ref[...].astype(cdt),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=f32,
+        )
+        + bd1_ref[...].astype(f32)
+    )
+    logits = (
+        jax.lax.dot_general(
+            hd.astype(cdt), wd2_ref[...].astype(cdt),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=f32,
+        )
+        + bd2_ref[...].astype(f32)
+    )
+    out_ref[...] = jax.nn.softmax(logits, axis=-1)
+
+
+def fused_cifar10_probs(
+    params: dict,
+    x: jnp.ndarray,
+    compute_dtype: Optional[Any] = jnp.bfloat16,
+    tile: int = 32,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Softmax probabilities [B, 10] for Cifar10ConvNet via the fused kernel.
+
+    Default tile 32: the conv1 activation block [tile, 30, 30, 32] is the
+    VMEM high-water mark (f32 accumulator), ~3.7 MB at 32.
+    """
+    if not HAVE_PALLAS:
+        raise RuntimeError("jax.experimental.pallas unavailable in this build")
+    cdt = jnp.dtype(compute_dtype) if compute_dtype is not None else jnp.dtype(
+        jnp.float32
+    )
+    names = ("Conv_0", "Conv_1", "Conv_2", "Dense_0", "Dense_1")
+    w = [params[n]["kernel"] for n in names]
+    bias = [params[n]["bias"] for n in names]
+    assert w[0].shape == (3, 3, 3, 32) and w[2].shape == (3, 3, 64, 64), (
+        "fused kernel mirrors the CIFAR-10 architecture only"
+    )
+    b = x.shape[0]
+    pad = (-b) % tile
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0
+        )
+    full = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+    operands = [x]
+    specs = [pl.BlockSpec((tile, 32, 32, 3), lambda i: (i, 0, 0, 0))]
+    for wk, bk in zip(w, bias):
+        operands += [wk, bk]
+        specs += [full(wk.shape), full(bk.shape)]
+    out = pl.pallas_call(
+        functools.partial(_cifar_kernel, cdt=cdt),
+        grid=(x.shape[0] // tile,),
+        in_specs=specs,
+        out_specs=pl.BlockSpec((tile, 10), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((x.shape[0], 10), jnp.float32),
+        interpret=interpret,
+    )(*operands)
+    return out[:b]
+
+
 def fused_mnist_probs(
     params: dict,
     x: jnp.ndarray,
@@ -179,25 +302,37 @@ def validate_against_model(
     tile: int = 64,
     interpret: bool = False,
     seed: int = 0,
+    family: str = "mnist",
 ) -> float:
     """Max |fused - flax| probability gap on random inputs (runtime gate).
 
-    bench.py refuses the fused path unless this is small; the flax model
+    Callers refuse the fused path unless this is small; the flax model
     runs in the SAME compute dtype, so the gap measures kernel-vs-XLA
     numerics, not bf16-vs-f32 rounding. ``tile`` must be the tile the
     caller will MEASURE with — lowering is tile-dependent, so validating
-    one tile says nothing about another.
+    one tile says nothing about another. ``family`` selects the kernel
+    ("mnist"/"fmnist" share one architecture; "cifar10" the other); each
+    family must be gated separately before trust on a given TPU
+    generation.
     """
-    from simple_tip_tpu.models import MnistConvNet
+    if family in ("mnist", "fmnist"):
+        from simple_tip_tpu.models import MnistConvNet as Model
 
+        shape, fused_fn = (28, 28, 1), fused_mnist_probs
+    elif family == "cifar10":
+        from simple_tip_tpu.models import Cifar10ConvNet as Model
+
+        shape, fused_fn = (32, 32, 3), fused_cifar10_probs
+    else:
+        raise ValueError(f"no fused kernel for family {family!r}")
     x = jnp.asarray(
-        np.random.default_rng(seed).normal(size=(n, 28, 28, 1)).astype(np.float32)
+        np.random.default_rng(seed).normal(size=(n,) + shape).astype(np.float32)
     )
-    model = MnistConvNet(
+    model = Model(
         compute_dtype=None
         if compute_dtype is None or jnp.dtype(compute_dtype) == jnp.float32
         else compute_dtype
     )
     ref_probs, _ = model.apply({"params": params}, x, train=False)
-    got = fused_mnist_probs(params, x, compute_dtype, tile=tile, interpret=interpret)
+    got = fused_fn(params, x, compute_dtype, tile=tile, interpret=interpret)
     return float(jnp.max(jnp.abs(got - ref_probs)))

@@ -74,8 +74,17 @@ def barrier(name: str, timeout_s: float = 1800.0) -> None:
             raise AttributeError("no distributed client")
         client.wait_at_barrier(name, timeout_in_ms=int(timeout_s * 1000))
         return
-    except (ImportError, AttributeError, TypeError):
-        # jax internals moved/renamed: degrade to the collective
+    except (ImportError, AttributeError, TypeError) as e:
+        # jax internals moved/renamed: degrade to the collective — LOUDLY,
+        # because the collective reintroduces the Gloo lazy-init skew
+        # sensitivity this function exists to avoid, and drops timeout_s.
+        logger.warning(
+            "coordination-service barrier unavailable (%r); falling back "
+            "to sync_global_devices(%s) — phase skew beyond Gloo's ~30 s "
+            "init window will fail here",
+            e,
+            name,
+        )
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(name)

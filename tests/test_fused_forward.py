@@ -64,3 +64,40 @@ def test_fused_probs_are_distributions(params):
     )
     np.testing.assert_allclose(got.sum(axis=1), 1.0, atol=1e-3)
     assert (got >= 0).all()
+
+
+@pytest.fixture(scope="module")
+def cifar_params():
+    from simple_tip_tpu.models import Cifar10ConvNet
+
+    return init_params(
+        Cifar10ConvNet(), jax.random.PRNGKey(1), np.zeros((1, 32, 32, 3), np.float32)
+    )
+
+
+def test_fused_cifar_matches_flax_f32(cifar_params):
+    from simple_tip_tpu.models import Cifar10ConvNet
+
+    x = jnp.asarray(
+        np.random.default_rng(3).normal(size=(40, 32, 32, 3)).astype(np.float32)
+    )
+    probs, _ = Cifar10ConvNet().apply({"params": cifar_params}, x, train=False)
+    got = fused_forward.fused_cifar10_probs(
+        cifar_params, x, compute_dtype=None, tile=32, interpret=True
+    )
+    assert got.shape == (40, 10)  # ragged batch padded internally
+    np.testing.assert_allclose(np.asarray(got), np.asarray(probs), atol=1e-5)
+
+
+def test_fused_cifar_matches_flax_bf16(cifar_params):
+    from simple_tip_tpu.models import Cifar10ConvNet
+
+    x = jnp.asarray(
+        np.random.default_rng(4).normal(size=(32, 32, 32, 3)).astype(np.float32)
+    )
+    model = Cifar10ConvNet(compute_dtype=jnp.bfloat16)
+    probs, _ = model.apply({"params": cifar_params}, x, train=False)
+    got = fused_forward.fused_cifar10_probs(
+        cifar_params, x, jnp.bfloat16, tile=32, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(probs), atol=5e-3)
