@@ -124,6 +124,38 @@ def main() -> int:
         _, t_ref = _timed(ref_mdsa, test, test_pred, repeats=3)
     rows.append((f"MDSA score ({feat} features, {n_test} test)", t_ours, t_ref))
 
+    # ---- silhouette k-sweep: the pc-mmdsa discriminator's fit core ----
+    # The reference scores each candidate k's labeling with sklearn's
+    # silhouette (src/core/surprise.py:102-133) — one full O(n^2 d)
+    # pairwise pass per k. Ours contracts ONE shared distance pass against
+    # all labelings (ops/cluster.silhouette_scores_multi).
+    from sklearn.cluster import KMeans as _SkKMeans
+    from sklearn.metrics import silhouette_score as _sk_sil
+
+    from simple_tip_tpu.ops.cluster import silhouette_scores_multi
+
+    n_sil, sil_feat = 6000, 512
+    sil_x = (
+        rng.normal(size=(n_sil, sil_feat)) * 0.5
+        + (rng.integers(0, 3, size=n_sil))[:, None]
+    ).astype(np.float32)
+    labelings = [
+        _SkKMeans(k, n_init=2, random_state=0).fit_predict(sil_x)
+        for k in range(2, 6)
+    ]
+    _timed(lambda: silhouette_scores_multi(sil_x, labelings))  # warmup
+    _, t_ours = _timed(lambda: silhouette_scores_multi(sil_x, labelings), repeats=3)
+    t_ref = None
+    if have_ref:
+        # sklearn's per-k silhouette IS the reference's loop body — gate it
+        # like every other reference-side measurement
+        _, t_ref = _timed(
+            lambda: [_sk_sil(sil_x, l) for l in labelings], repeats=3
+        )
+    rows.append(
+        (f"silhouette k-sweep k=2..5 ({n_sil}x{sil_feat})", t_ours, t_ref)
+    )
+
     # ---- LSA: KDE density (fit + eval; float64 host math on both sides) ----
     n_kde_train, n_kde_test, kde_feat = 4096, 2048, 128
     kde_train = rng.normal(size=(n_kde_train, kde_feat)).astype(np.float32)
