@@ -1,0 +1,121 @@
+"""Shared evaluation-and-export tail for study drivers.
+
+One implementation of the three steps scripts/mini_study.py and
+scripts/study_eval.py both need — so mask naming, the plotter set, and the
+manifest schema cannot silently diverge between the mini and paper-scale
+buses (round-5 advisor reuse finding):
+
+- ``run_all_evals``: the four reference evaluations
+  (src/plotters/: APFD table, AL table, both correlation statistics).
+- ``nominal_fault_rates``: measured nominal misclassification rates read
+  from the prio phase's own persisted ``is_misclassified`` masks — the
+  exact masks the APFD tables consume.
+- ``export_results``: STAGED copy of ``$TIP_ASSETS/results`` plus a
+  MANIFEST into ``results/<name>/`` — the tables and manifest land
+  together via a directory rename, so a killed eval can never leave fresh
+  tables described by a stale manifest.
+"""
+
+import json
+import os
+import shutil
+import sys
+import time
+from typing import Dict, Optional, Sequence
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_all_evals(case_studies: Sequence[str]) -> None:
+    from simple_tip_tpu.plotters import (
+        eval_active_correlation,
+        eval_active_learning_table,
+        eval_apfd_correlation,
+        eval_apfd_table,
+    )
+
+    for run in (
+        eval_apfd_table.run,
+        eval_active_learning_table.run,
+        eval_apfd_correlation.run,
+        eval_active_correlation.run,
+    ):
+        run(case_studies=tuple(case_studies))
+
+
+def nominal_fault_rates(
+    assets: str, case_studies: Sequence[str], runs: int
+) -> Dict[str, dict]:
+    import numpy as np
+
+    out: Dict[str, dict] = {}
+    prio = os.path.join(assets, "priorities")
+    for cs in case_studies:
+        rates = []
+        for rid in range(runs):
+            p = os.path.join(prio, f"{cs}_nominal_{rid}_is_misclassified.npy")
+            if os.path.exists(p):
+                rates.append(float(np.load(p).mean()))
+        if rates:
+            out[cs] = {
+                "nominal_fault_rate_mean": round(float(np.mean(rates)), 4),
+                "runs": len(rates),
+            }
+    return out
+
+
+def export_results(
+    assets: str, out_dir: str, manifest: dict, manifest_name: str = "MANIFEST.json"
+) -> list:
+    """Copy ``assets/results`` + manifest into ``out_dir`` atomically.
+
+    Stages everything in ``out_dir + '.staging'`` and swaps directories at
+    the end; returns the copied artifact names (also stored in the
+    manifest under ``artifacts``).
+    """
+    src = os.path.join(assets, "results")
+    staging = out_dir.rstrip("/") + ".staging"
+    shutil.rmtree(staging, ignore_errors=True)
+    os.makedirs(staging)
+    copied = sorted(os.listdir(src))
+    for fn in copied:
+        shutil.copyfile(os.path.join(src, fn), os.path.join(staging, fn))
+    manifest = dict(manifest)
+    manifest.setdefault("artifacts", copied)
+    manifest.setdefault("captured_unix", round(time.time(), 1))
+    with open(os.path.join(staging, manifest_name), "w") as f:
+        json.dump(manifest, f, indent=1)
+    old = out_dir.rstrip("/") + ".old"
+    shutil.rmtree(old, ignore_errors=True)
+    if os.path.isdir(out_dir):
+        os.rename(out_dir, old)
+    os.rename(staging, out_dir)
+    shutil.rmtree(old, ignore_errors=True)
+    return copied
+
+
+def hardness_env_label() -> str:
+    val = os.environ.get("TIP_SYNTH_HARDNESS")
+    if val:
+        return val
+    from simple_tip_tpu.data.synthetic import DEFAULT_HARDNESS
+
+    return f"default({DEFAULT_HARDNESS})"
+
+
+def study_provenance(study_json: Optional[str]) -> dict:
+    if not study_json:
+        return {}
+    try:
+        with open(study_json) as f:
+            study = json.load(f)
+        return {
+            "study_json": os.path.basename(study_json),
+            "synth_hardness": study.get("synth_hardness"),
+            "runs_requested": study.get("runs_requested"),
+            "summary": study.get("summary"),
+            "platform_policy": study.get("platform_policy"),
+        }
+    except (OSError, ValueError) as e:
+        return {"study_json_error": repr(e)}

@@ -82,27 +82,20 @@ def main() -> int:
         # calibrated-hardness stand-ins the trained models must misclassify
         # a realistic few percent of nominal inputs — recorded in the
         # manifest so the populated nominal-APFD columns carry their
-        # provenance. Read from the phase's own persisted
-        # is_misclassified masks (priorities bus): free, and guaranteed to
-        # be the exact masks the APFD tables consume.
-        import numpy as np
+        # provenance. Read from the phase's own persisted masks (shared
+        # helper with study_eval.py): free, and guaranteed to match what
+        # the APFD tables consume.
+        from scripts.eval_export import nominal_fault_rates
 
-        rates = []
-        prio_dir = os.path.join(os.environ["TIP_ASSETS"], "priorities")
-        for rid in run_ids:
-            mask_path = os.path.join(
-                prio_dir, f"{cs_name}_nominal_{rid}_is_misclassified.npy"
-            )
-            if os.path.exists(mask_path):
-                rates.append(float(np.load(mask_path).mean()))
-        if rates:
-            fault_rates[cs_name] = {
-                "nominal_fault_rate_mean": round(float(np.mean(rates)), 4),
-                "runs": len(rates),
-            }
+        fr = nominal_fault_rates(
+            os.environ["TIP_ASSETS"], [cs_name], len(run_ids)
+        )
+        if cs_name in fr:
+            fault_rates[cs_name] = fr[cs_name]
             print(
-                f"[{cs_name}] nominal fault rate over {len(rates)} runs: "
-                f"{np.mean(rates):.3%}",
+                f"[{cs_name}] nominal fault rate over "
+                f"{fr[cs_name]['runs']} runs: "
+                f"{fr[cs_name]['nominal_fault_rate_mean']:.2%}",
                 flush=True,
             )
 
@@ -143,34 +136,20 @@ def main() -> int:
             flush=True,
         )
 
-    # --- all four evaluations over the multi-run bus ---
-    from simple_tip_tpu.plotters import (
-        eval_active_correlation,
-        eval_active_learning_table,
-        eval_apfd_correlation,
-        eval_apfd_table,
-    )
+    # --- all four evaluations + atomic export (shared tail with
+    # scripts/study_eval.py — scripts/eval_export.py) ---
+    from scripts.eval_export import export_results, hardness_env_label, run_all_evals
 
     t0 = time.time()
-    eval_apfd_table.run(case_studies=CASE_STUDIES)
-    eval_active_learning_table.run(case_studies=CASE_STUDIES)
-    eval_apfd_correlation.run(case_studies=CASE_STUDIES)
-    eval_active_correlation.run(case_studies=CASE_STUDIES)
+    run_all_evals(CASE_STUDIES)
     timings["evaluation"] = round(time.time() - t0, 1)
     print(f"evaluations done in {timings['evaluation']}s", flush=True)
 
-    # --- copy the results/ tables into the repo for commit ---
-    src = os.path.join(os.environ["TIP_ASSETS"], "results")
-    os.makedirs(args.out, exist_ok=True)
-    copied = []
-    for fn in sorted(os.listdir(src)):
-        shutil.copyfile(os.path.join(src, fn), os.path.join(args.out, fn))
-        copied.append(fn)
     manifest = {
         "case_studies": list(CASE_STUDIES),
         "runs": args.runs,
         "workers": args.workers,
-        "synth_hardness": os.environ.get("TIP_SYNTH_HARDNESS", "default(0.08)"),
+        "synth_hardness": hardness_env_label(),
         "nominal_fault_rates": fault_rates,
         "al_gap": (
             f"runs {args.al_runs}-{args.runs - 1} have no AL artifacts "
@@ -180,12 +159,9 @@ def main() -> int:
             else "none: every run has AL artifacts"
         ),
         "phase_wall_clock_s": timings,
-        "artifacts": copied,
         "reproduce": "python scripts/mini_study.py",
-        "captured_unix": round(time.time(), 1),
     }
-    with open(os.path.join(args.out, "MANIFEST.json"), "w") as f:
-        json.dump(manifest, f, indent=1)
+    export_results(os.environ["TIP_ASSETS"], args.out, manifest)
     print(json.dumps(manifest["phase_wall_clock_s"]))
     return 0
 

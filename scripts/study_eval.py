@@ -1,0 +1,79 @@
+"""Regenerate the evaluation tables + MANIFEST for a captured study bus.
+
+One command replaces the inline-python recipe recorded in
+results/study_r04/MANIFEST.json: run all four evaluations over a study's
+TIP_ASSETS bus and atomically export the tables + a provenance MANIFEST
+into ``results/<name>/`` (run count, synthetic hardness, measured nominal
+fault rates from the prio phase's own persisted masks, reproduction
+commands). Shared implementation with the mini-study driver:
+scripts/eval_export.py.
+
+Usage:
+  TIP_ASSETS=/tmp/tpu_study_assets_r05 python scripts/study_eval.py \\
+      --name study_r05 --case-studies mnist [--study-json STUDY_r05.json]
+
+Reference analog: the four plotters of src/plotters/* driven by
+reproduction.py's EVALUATION phase; table shape
+src/plotters/eval_apfd_table.py:43-131.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scripts.eval_export import (  # noqa: E402
+    export_results,
+    hardness_env_label,
+    nominal_fault_rates,
+    run_all_evals,
+    study_provenance,
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--name", required=True, help="results/<name>/ output dir")
+    ap.add_argument("--case-studies", default="mnist")
+    ap.add_argument("--study-json", default=None,
+                    help="optional STUDY json whose provenance to embed")
+    ap.add_argument("--runs", type=int, default=100,
+                    help="run-id range to scan for fault rates (canon 100)")
+    args = ap.parse_args()
+
+    assets = os.environ.get("TIP_ASSETS")
+    if not assets or not os.path.isdir(assets):
+        print(f"TIP_ASSETS={assets!r} is not a directory", file=sys.stderr)
+        return 1
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # aggregation is host work
+
+    case_studies = tuple(s for s in args.case_studies.split(",") if s)
+    run_all_evals(case_studies)
+    rates = nominal_fault_rates(assets, case_studies, args.runs)
+    manifest = {
+        "what": f"Evaluation tables over the {args.name} bus",
+        "source_assets": assets,
+        "case_studies": list(case_studies),
+        "synth_hardness_env": hardness_env_label(),
+        "nominal_fault_rates": rates,
+        "study_provenance": study_provenance(args.study_json),
+        "reproduce": [
+            f"TIP_ASSETS={assets} python scripts/study_eval.py "
+            f"--name {args.name} --case-studies {args.case_studies}"
+            + (f" --study-json {args.study_json}" if args.study_json else ""),
+        ],
+    }
+    out_dir = os.path.join(REPO, "results", args.name)
+    export_results(assets, out_dir, manifest)
+    print(json.dumps({"out": out_dir, "fault_rates": rates}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
