@@ -68,14 +68,23 @@ def nominal_fault_rates(
 def export_results(
     assets: str, out_dir: str, manifest: dict, manifest_name: str = "MANIFEST.json"
 ) -> list:
-    """Copy ``assets/results`` + manifest into ``out_dir`` atomically.
+    """Copy ``assets/results`` + manifest into ``out_dir`` via a staged
+    directory swap.
 
-    Stages everything in ``out_dir + '.staging'`` and swaps directories at
-    the end; returns the copied artifact names (also stored in the
-    manifest under ``artifacts``).
+    Tables and manifest always land TOGETHER (a killed eval can never
+    leave fresh tables under a stale manifest). The swap itself is two
+    renames, not one atomic op: a kill exactly between them leaves
+    ``out_dir`` absent with the previous export preserved in ``.old`` —
+    the next invocation restores it before doing anything else. Returns
+    the copied artifact names (also stored in the manifest under
+    ``artifacts``).
     """
     src = os.path.join(assets, "results")
     staging = out_dir.rstrip("/") + ".staging"
+    old = out_dir.rstrip("/") + ".old"
+    # recover from a kill between the two swap renames of a prior run
+    if not os.path.isdir(out_dir) and os.path.isdir(old):
+        os.rename(old, out_dir)
     shutil.rmtree(staging, ignore_errors=True)
     os.makedirs(staging)
     copied = sorted(os.listdir(src))
@@ -86,7 +95,6 @@ def export_results(
     manifest.setdefault("captured_unix", round(time.time(), 1))
     with open(os.path.join(staging, manifest_name), "w") as f:
         json.dump(manifest, f, indent=1)
-    old = out_dir.rstrip("/") + ".old"
     shutil.rmtree(old, ignore_errors=True)
     if os.path.isdir(out_dir):
         os.rename(out_dir, old)

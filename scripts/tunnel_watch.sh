@@ -77,28 +77,33 @@ while true; do
       --runs "$runs_target" --study-json "$STUDY5"
   fi
   # regenerate the r05 tables whenever the bus has grown since the last
-  # eval. Gate compares LIKE-FOR-LIKE: the study's summary.test_prio
-  # runs_ok vs the same field the manifest embedded from the study json at
-  # its own eval time (study_provenance.summary) — mask-file counts can
-  # legitimately disagree with runs_ok (a run can persist its mask then
-  # time out later), which would re-trigger the eval forever.
+  # eval. Gate compares LIKE-FOR-LIKE: the study json's WHOLE per-phase
+  # summary vs the same dict the manifest embedded at its own eval time
+  # (study_provenance.summary) — any phase advancing (test_prio during
+  # outages, active_learning when a window opens) re-arms the eval, and
+  # mask-file counts (which can legitimately disagree with runs_ok) are
+  # never consulted.
   need_eval=$(python - <<EOF
 import json
 try:
-    s = json.load(open("$STUDY5"))["summary"]["test_prio"]["runs_ok"]
+    s = json.load(open("$STUDY5")).get("summary") or {}
 except Exception:
-    s = 0
+    s = {}
 try:
     m = json.load(open("results/study_r05/MANIFEST.json"))[
-        "study_provenance"]["summary"]["test_prio"]["runs_ok"]
+        "study_provenance"].get("summary") or {}
 except Exception:
-    m = -1
-print(int(s > 0 and s != m))
+    m = None
+print(int(bool(s) and s != m))
 EOF
 )
   if [ "$need_eval" = "1" ]; then
+    # fault-rate scan range follows the study's persisted target, like the
+    # capture step (a hard-coded count would silently under-average a
+    # widened bus)
+    eval_runs=$(python -c "import json;print(max(10,int(json.load(open('$STUDY5')).get('runs_requested',10))))" 2>/dev/null || echo 10)
     TIP_ASSETS=/tmp/tpu_study_assets_r05 timeout 3600 python scripts/study_eval.py \
-      --name study_r05 --case-studies mnist --study-json "$STUDY5" --runs 30 \
+      --name study_r05 --case-studies mnist --study-json "$STUDY5" --runs "$eval_runs" \
       || echo "$(date -u +%FT%TZ) study_eval failed/timed out; will retry next cycle"
   fi
   if have_json_flag "$STUDY" complete \

@@ -1,0 +1,101 @@
+"""scripts/eval_export.py: the shared evaluation-export tail. The export
+must be atomic (tables + manifest land together or not at all) and the
+fault-rate scan must read exactly the masks the prio phase persists."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+SCRIPT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts",
+    "eval_export.py",
+)
+
+
+@pytest.fixture()
+def ex():
+    spec = importlib.util.spec_from_file_location("eval_export", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_export_results_is_staged_and_replaces(ex, tmp_path):
+    assets = tmp_path / "assets"
+    (assets / "results").mkdir(parents=True)
+    (assets / "results" / "apfds.csv").write_text("a,b\n1,2\n")
+    out = tmp_path / "out" / "study_x"
+    out.parent.mkdir()
+
+    copied = ex.export_results(str(assets), str(out), {"what": "t1"})
+    assert copied == ["apfds.csv"]
+    assert (out / "apfds.csv").read_text() == "a,b\n1,2\n"
+    m1 = json.loads((out / "MANIFEST.json").read_text())
+    assert m1["what"] == "t1" and m1["artifacts"] == ["apfds.csv"]
+    assert "captured_unix" in m1
+
+    # second export REPLACES the directory wholesale: stale files from the
+    # first export must not survive next to a new manifest
+    (assets / "results" / "apfds.csv").write_text("a,b\n3,4\n")
+    (out / "stale_leftover.txt").write_text("old")
+    ex.export_results(str(assets), str(out), {"what": "t2"})
+    assert (out / "apfds.csv").read_text() == "a,b\n3,4\n"
+    assert not (out / "stale_leftover.txt").exists()
+    assert json.loads((out / "MANIFEST.json").read_text())["what"] == "t2"
+    # no staging/old residue
+    assert not (out.parent / "study_x.staging").exists()
+    assert not (out.parent / "study_x.old").exists()
+
+
+def test_nominal_fault_rates_reads_engine_masks(ex, tmp_path):
+    prio = tmp_path / "priorities"
+    prio.mkdir()
+    # engine naming contract: {cs}_{ds}_{run}_is_misclassified.npy
+    np.save(prio / "mnist_nominal_0_is_misclassified.npy",
+            np.array([True, False, False, False]))
+    np.save(prio / "mnist_nominal_1_is_misclassified.npy",
+            np.array([True, True, False, False]))
+    np.save(prio / "mnist_ood_0_is_misclassified.npy",
+            np.array([True, True, True, True]))  # ood must NOT count
+    rates = ex.nominal_fault_rates(str(tmp_path), ["mnist", "absent"], runs=10)
+    assert rates == {
+        "mnist": {"nominal_fault_rate_mean": 0.375, "runs": 2}
+    }
+
+
+def test_study_provenance_embeds_summary(ex, tmp_path):
+    sj = tmp_path / "S.json"
+    sj.write_text(json.dumps({
+        "synth_hardness": 0.08,
+        "runs_requested": 30,
+        "summary": {"test_prio": {"runs_ok": 12}},
+    }))
+    p = ex.study_provenance(str(sj))
+    assert p["runs_requested"] == 30
+    assert p["summary"]["test_prio"]["runs_ok"] == 12
+    assert ex.study_provenance(None) == {}
+    bad = ex.study_provenance(str(tmp_path / "missing.json"))
+    assert "study_json_error" in bad
+
+
+def test_export_recovers_from_interrupted_swap(ex, tmp_path):
+    """A kill between the two swap renames leaves out_dir absent and the
+    previous export in .old; the next invocation must restore it before
+    exporting (and then replace it normally)."""
+    assets = tmp_path / "assets"
+    (assets / "results").mkdir(parents=True)
+    (assets / "results" / "t.csv").write_text("new")
+    out = tmp_path / "study_x"
+    old = tmp_path / "study_x.old"
+    old.mkdir()
+    (old / "t.csv").write_text("previous")
+    (old / "MANIFEST.json").write_text(json.dumps({"what": "prev"}))
+
+    ex.export_results(str(assets), str(out), {"what": "recovered"})
+    assert (out / "t.csv").read_text() == "new"
+    assert json.loads((out / "MANIFEST.json").read_text())["what"] == "recovered"
+    assert not old.exists()
