@@ -531,6 +531,31 @@ class MLSA(SA):
         _, _, GaussianMixture = _cluster_backend()
 
         activations = _flatten_layers(activations)
+        if activations.shape[0] < num_components:
+            # Tiny modal: per-class/per-cluster MLSA can receive fewer
+            # samples than mixture components (seen in practice: a weak
+            # small-data model predicting a class only twice). sklearn
+            # requires n_samples >= n_components and NO reg_covar fixes
+            # that, so the escalation ladder would exhaust and abort the
+            # whole run — the reference's fixed-default fit would crash
+            # identically (src/core/surprise.py:498-520); it just never
+            # meets per-class counts this small. Clamp with a loud warning:
+            # a k-point GMM over k points is degenerate-but-defined, and
+            # the resulting scores keep their role (such samples are
+            # maximally surprising to everything else anyway).
+            warnings.warn(
+                f"MLSA modal has only {activations.shape[0]} samples for "
+                f"{num_components} mixture components; clamping components "
+                "to the sample count"
+            )
+            num_components = max(1, activations.shape[0])
+            if activations.shape[0] == 1:
+                # sklearn additionally requires n_samples >= 2 outright; a
+                # duplicated row fits a point-mass Gaussian of reg_covar
+                # width at the sample — defined, and maximally surprising
+                # to everything away from it (same spirit as LSA's
+                # documented single-sample degraded mode)
+                activations = np.repeat(activations, 2, axis=0)
         logger.info("Fitting Gaussian Mixture with %d components", num_components)
         # Degenerate activation sets (collapsed features / near-singleton
         # components at small scale) can make the default reg_covar=1e-6 fit
