@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Tier-0 static gate: bytecode-compile the package, then run the tiplint
+# analyzer (simple_tip_tpu/analysis) in text mode. Exits non-zero on any
+# syntax error or unsuppressed finding. Needs NO third-party packages —
+# the analyzer is stdlib-ast only — so it runs before the environment has
+# jax installed (CI lint job, pre-commit).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m compileall -q simple_tip_tpu
+python -m simple_tip_tpu.analysis simple_tip_tpu --format text

@@ -1,0 +1,31 @@
+"""tiplint: JAX/TPU-aware static analysis for the simple_tip_tpu codebase.
+
+A self-contained (stdlib-``ast``, zero third-party imports) linter catching
+the defect classes that sink TPU systems statically: impure jitted functions,
+reused PRNG keys, implicit host↔device syncs in hot paths, f64 dtypes that
+silently downcast on TPU, undonated multi-GB ensemble buffers, drift in the
+filesystem artifact contract between the engine (writers) and the plotters
+(readers), and docstring-coverage regressions.
+
+Usage::
+
+    python -m simple_tip_tpu.analysis [paths...] [--format text|json]
+    python -m simple_tip_tpu.analysis --list-rules
+
+Suppress an intentional finding inline with a justification comment::
+
+    x = np.asarray(batch, dtype=np.float64)  # tiplint: disable=f64-on-tpu
+
+See README.md section "Static analysis (tiplint)" for the rule catalogue.
+"""
+
+from simple_tip_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    ModuleInfo,
+    Rule,
+    all_rules,
+    analyze_paths,
+    register,
+    unsuppressed,
+)
+from simple_tip_tpu.analysis.cli import main  # noqa: F401

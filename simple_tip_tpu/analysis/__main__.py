@@ -1,0 +1,8 @@
+"""``python -m simple_tip_tpu.analysis`` — run the tiplint CLI."""
+
+import sys
+
+from simple_tip_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""tiplint command line: ``python -m simple_tip_tpu.analysis [paths...]``.
+
+Exit status is the contract consumed by scripts/lint.sh and CI: 0 when every
+finding is suppressed (or there are none), 1 when unsuppressed findings
+remain, 2 on usage errors.
+"""
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from simple_tip_tpu.analysis.core import all_rules, analyze_paths, unsuppressed
+from simple_tip_tpu.analysis.reporters import REPORTERS, render
+
+
+def _default_target() -> str:
+    """The installed ``simple_tip_tpu`` package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """The tiplint argument parser (exposed for --help doc tooling)."""
+    parser = argparse.ArgumentParser(
+        prog="python -m simple_tip_tpu.analysis",
+        description=(
+            "tiplint: JAX/TPU-aware static analysis for simple_tip_tpu "
+            "(jit purity, PRNG hygiene, host syncs, f64-on-TPU, buffer "
+            "donation, artifact contract, docstring coverage)."
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to analyze (default: the simple_tip_tpu package)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=sorted(REPORTERS),
+        default="text",
+        help="report format (default: text)",
+    )
+    parser.add_argument(
+        "--select",
+        default=None,
+        help="comma-separated rule names to run (default: all rules)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the registered rules and exit",
+    )
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Entry point; returns the process exit status."""
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for name, rule in sorted(all_rules().items()):
+            print(f"{name}: {rule.description}")
+        return 0
+
+    paths = args.paths or [_default_target()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"tiplint: no such path: {p}", file=sys.stderr)
+            return 2
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+    try:
+        findings = analyze_paths(paths, select=select)
+    except KeyError as exc:
+        print(f"tiplint: {exc.args[0]}", file=sys.stderr)
+        return 2
+    try:
+        print(render(findings, args.format))
+        sys.stdout.flush()
+    except BrokenPipeError:
+        # Downstream pager/head closed the pipe; the analysis still ran, so
+        # keep the finding-based exit status instead of tracebacking. Point
+        # stdout at devnull so the interpreter's shutdown flush stays quiet.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+    return 1 if unsuppressed(findings) else 0

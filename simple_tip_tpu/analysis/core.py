@@ -1,0 +1,223 @@
+"""tiplint core: module model, rule registry, suppressions, analyzer driver.
+
+Pure stdlib (``ast`` + ``os`` + ``re``): the analyzer must run in
+dependency-light environments (CI lint gate, pre-commit) where jax is not
+installed, so nothing in ``simple_tip_tpu.analysis`` may import jax, numpy or
+any third-party package.
+
+Vocabulary:
+
+- A **Rule** inspects parsed modules and yields findings. Per-module rules
+  implement ``check_module``; whole-package rules (cross-file contracts)
+  implement ``check_package``.
+- A **Finding** is (rule, path, line, message). A finding is *suppressed*
+  when the offending line (or a comment-only line directly above it) carries
+  ``# tiplint: disable=<rule>[,<rule>...]``, or the file carries a
+  file-level ``# tiplint: disable-file=<rule>`` anywhere. Suppressions are
+  reported (so silent rot is visible) but do not fail the run.
+"""
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field, replace
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+_DISABLE_RE = re.compile(r"#\s*tiplint:\s*disable=([\w\-, ]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*tiplint:\s*disable-file=([\w\-, ]+)")
+_COMMENT_ONLY_RE = re.compile(r"^\s*#")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One static-analysis finding, anchored to a file and line."""
+
+    rule: str
+    path: str  # path relative to the analysis root (or absolute for stray files)
+    line: int
+    message: str
+    suppressed: bool = False
+
+    def format(self) -> str:
+        """Render as the canonical ``path:line: [rule] message`` text line."""
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source module plus its suppression table."""
+
+    path: str  # absolute path on disk
+    relpath: str  # path relative to the analysis root, always '/'-separated
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    # line number -> set of rule names disabled on that line ('all' wildcard)
+    line_disables: Dict[int, Set[str]] = field(default_factory=dict)
+    file_disables: Set[str] = field(default_factory=set)
+
+    @classmethod
+    def parse(cls, path: str, root: str) -> "ModuleInfo":
+        """Read and parse ``path``; raises SyntaxError on unparsable source."""
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        info = cls(path=path, relpath=rel, source=source, tree=tree)
+        info.lines = source.splitlines()
+        for lineno, text in enumerate(info.lines, start=1):
+            m = _DISABLE_FILE_RE.search(text)
+            if m:
+                info.file_disables.update(_split_rules(m.group(1)))
+                continue
+            m = _DISABLE_RE.search(text)
+            if m:
+                info.line_disables[lineno] = _split_rules(m.group(1))
+        return info
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        """True if ``rule`` is disabled at ``line`` (inline, previous
+        comment-only line, or file-wide)."""
+        if {"all", rule} & self.file_disables:
+            return True
+        here = self.line_disables.get(line, set())
+        if {"all", rule} & here:
+            return True
+        # A standalone suppression comment may sit on its own line directly
+        # above the flagged statement (useful for long expressions).
+        prev = line - 1
+        if 1 <= prev <= len(self.lines) and _COMMENT_ONLY_RE.match(self.lines[prev - 1]):
+            if {"all", rule} & self.line_disables.get(prev, set()):
+                return True
+        return False
+
+
+def _split_rules(spec: str) -> Set[str]:
+    return {part.strip() for part in spec.split(",") if part.strip()}
+
+
+class Rule:
+    """Base class for tiplint rules.
+
+    Subclasses set ``name``/``description`` and override ``check_module``
+    (called once per file) and/or ``check_package`` (called once per run
+    with every parsed module — for cross-file contracts). Both yield
+    ``(relpath, line, message)`` triples; the driver owns Finding assembly
+    and suppression bookkeeping.
+    """
+
+    name: str = ""
+    description: str = ""
+
+    def check_module(
+        self, module: ModuleInfo
+    ) -> Iterator[Tuple[str, int, str]]:
+        """Per-file check; default: no findings."""
+        return iter(())
+
+    def check_package(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Tuple[str, int, str]]:
+        """Whole-package check; default: no findings."""
+        return iter(())
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_cls):
+    """Class decorator: instantiate the rule and add it to the registry."""
+    rule = rule_cls()
+    assert rule.name, f"{rule_cls.__name__} must set a rule name"
+    assert rule.name not in _REGISTRY, f"duplicate rule name {rule.name!r}"
+    _REGISTRY[rule.name] = rule
+    return rule_cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    """name -> rule instance for every registered rule (registration happens
+    on import of ``simple_tip_tpu.analysis.rules``)."""
+    from simple_tip_tpu.analysis import rules as _rules  # noqa: F401 (side effect)
+
+    return dict(_REGISTRY)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[Tuple[str, str]]:
+    """Yield (absolute file path, analysis root) for every .py under ``paths``.
+
+    A directory argument is its own root (relpaths are computed against it);
+    a file argument uses its parent directory as root. Hidden directories and
+    __pycache__ are skipped.
+    """
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            yield p, os.path.dirname(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d for d in dirnames if not d.startswith(".") and d != "__pycache__"
+            ]
+            for fname in sorted(filenames):
+                if fname.endswith(".py"):
+                    yield os.path.join(dirpath, fname), p
+
+
+def analyze_paths(
+    paths: Sequence[str], select: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Run the (selected) rules over every module under ``paths``.
+
+    Returns all findings, suppressed ones included (marked); callers decide
+    what fails the run (the CLI exits non-zero on any unsuppressed finding).
+    """
+    rules = all_rules()
+    if select:
+        unknown = sorted(set(select) - set(rules))
+        if unknown:
+            raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
+        rules = {name: rules[name] for name in select}
+
+    modules: List[ModuleInfo] = []
+    findings: List[Finding] = []
+    by_rel: Dict[str, ModuleInfo] = {}
+    for path, root in iter_python_files(paths):
+        try:
+            info = ModuleInfo.parse(path, root)
+        except SyntaxError as exc:
+            findings.append(
+                Finding(
+                    rule="parse-error",
+                    path=os.path.relpath(path, root).replace(os.sep, "/"),
+                    line=exc.lineno or 1,
+                    message=f"could not parse: {exc.msg}",
+                )
+            )
+            continue
+        modules.append(info)
+        by_rel[info.relpath] = info
+
+    for rule in rules.values():
+        raw: List[Tuple[str, int, str]] = []
+        for module in modules:
+            raw.extend(
+                (module.relpath, line, msg)
+                for _rel, line, msg in rule.check_module(module)
+            )
+        raw.extend(rule.check_package(modules))
+        for rel, line, msg in raw:
+            module = by_rel.get(rel)
+            suppressed = module.is_suppressed(rule.name, line) if module else False
+            findings.append(
+                Finding(rule=rule.name, path=rel, line=line, message=msg,
+                        suppressed=suppressed)
+            )
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+def unsuppressed(findings: Iterable[Finding]) -> List[Finding]:
+    """The findings that fail a lint run."""
+    return [f for f in findings if not f.suppressed]

@@ -1,0 +1,54 @@
+"""tiplint output formats: human text and machine JSON.
+
+Both reporters consume the full finding list (suppressed findings included)
+so suppression debt stays visible in every report.
+"""
+
+import json
+from typing import Iterable, List
+
+from simple_tip_tpu.analysis.core import Finding, unsuppressed
+
+
+def text_report(findings: Iterable[Finding]) -> str:
+    """One ``path:line: [rule] message`` line per finding plus a summary."""
+    findings = list(findings)
+    active = unsuppressed(findings)
+    lines = [f.format() for f in findings]
+    lines.append(
+        f"tiplint: {len(active)} finding(s), "
+        f"{len(findings) - len(active)} suppressed"
+    )
+    return "\n".join(lines)
+
+
+def json_report(findings: Iterable[Finding]) -> str:
+    """Stable JSON document: per-finding records plus summary counts."""
+    findings = list(findings)
+    active = unsuppressed(findings)
+    doc = {
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "message": f.message,
+                "suppressed": f.suppressed,
+            }
+            for f in findings
+        ],
+        "summary": {
+            "total": len(findings),
+            "unsuppressed": len(active),
+            "suppressed": len(findings) - len(active),
+        },
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+REPORTERS = {"text": text_report, "json": json_report}
+
+
+def render(findings: List[Finding], fmt: str) -> str:
+    """Dispatch to the named reporter (KeyError on unknown format)."""
+    return REPORTERS[fmt](findings)

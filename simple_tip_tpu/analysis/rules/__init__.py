@@ -1,0 +1,16 @@
+"""tiplint rule catalogue — importing this package registers every rule.
+
+Rules register themselves via the ``@register`` class decorator on import;
+``core.all_rules()`` imports this package to trigger that, so adding a rule
+is: create the module, decorate the class, import it here.
+"""
+
+from simple_tip_tpu.analysis.rules import (  # noqa: F401
+    artifact_contract,
+    buffer_donation,
+    docstring_coverage,
+    f64_on_tpu,
+    host_sync,
+    jit_purity,
+    prng_hygiene,
+)

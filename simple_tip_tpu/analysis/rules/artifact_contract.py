@@ -1,0 +1,286 @@
+"""Rule ``artifact-contract``: the filesystem bus must not silently drift.
+
+The engine phases and the result aggregation communicate exclusively through
+the filesystem artifact bus (config.py docstring): engine writes
+``priorities/{cs}_{ds}_{model}_{type}.npy``, timing pickles and AL pickles;
+plotters and the completeness auditor parse those names back by underscore
+splitting. Nothing ties the two sides together at runtime — a renamed field
+or changed extension on one side produces an aggregation that silently reads
+*nothing*. This rule makes the contract a lint invariant.
+
+Model: a **bus** is a first-level artifact directory referenced via
+``subdir("<name>")``, ``os.path.join(output_folder(), "<name>", ...)``,
+``Path(output_folder()) / "<name>"`` or ``load_all_for_regex("<name>", ..)``.
+Modules under ``engine/`` are the bus's writer side; modules under
+``plotters/`` and ``utils/`` are its reader side. An f-string in the same
+function scope as a bus reference that looks like an artifact filename
+(``.npy``/``.pickle`` suffix, or suffix-less with >= 3 ``_``-separated
+fields) is that bus's name template; a placeholder may expand to several
+fields, so a writer template with W fields satisfies a reader expecting
+R <= W fields of the same suffix.
+
+Findings:
+
+- a non-exempt bus written by engine with no reader in plotters/utils
+  (orphaned artifacts), and vice versa (reader of a bus nobody writes);
+- a reader name-template no writer template satisfies (and vice versa):
+  suffix mismatch or reader expecting more fields than the writer emits.
+
+Exempt buses: ``results`` (terminal plot/table output), ``models``
+(engine-internal checkpoints), ``activations``/``.tmp`` (engine-internal
+spill, bounded and self-consumed).
+"""
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.common import callee_name, import_aliases, parent_map
+
+EXEMPT_BUSES = {"results", "models", "activations", ".tmp"}
+WRITER_PREFIXES = ("engine/",)
+READER_PREFIXES = ("plotters/", "utils/")
+ARTIFACT_SUFFIXES = {".npy", ".pickle", ".pkl", ".msgpack"}
+
+_SUFFIX_RE = re.compile(r"(\.[A-Za-z0-9]+)$")
+
+
+@dataclass(frozen=True)
+class _BusUse:
+    bus: str
+    relpath: str
+    line: int
+
+
+@dataclass(frozen=True)
+class _Template:
+    bus: str
+    fields: int
+    suffix: str
+    relpath: str
+    line: int
+    text: str
+
+
+def _enclosing_function(node: ast.AST, parents) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _bus_name_from_call(node: ast.Call, aliases) -> Optional[str]:
+    """The bus name if this call references a first-level bus directory."""
+    name = callee_name(node, aliases)
+    tail = name.rsplit(".", 1)[-1] if name else None
+    if tail == "subdir" and node.args:
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+    if tail == "load_all_for_regex" and node.args:
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+    if name in ("os.path.join", "posixpath.join") and len(node.args) >= 2:
+        first, second = node.args[0], node.args[1]
+        if (
+            isinstance(first, ast.Call)
+            and (callee_name(first, aliases) or "").rsplit(".", 1)[-1]
+            == "output_folder"
+            and isinstance(second, ast.Constant)
+            and isinstance(second.value, str)
+        ):
+            return second.value
+    return None
+
+
+def _bus_name_from_binop(node: ast.BinOp, aliases) -> Optional[str]:
+    """``Path(output_folder()) / "bus"`` pattern."""
+    if not isinstance(node.op, ast.Div):
+        return None
+    if not (
+        isinstance(node.right, ast.Constant) and isinstance(node.right.value, str)
+    ):
+        return None
+    for sub in ast.walk(node.left):
+        if isinstance(sub, ast.Call):
+            tail = (callee_name(sub, aliases) or "").rsplit(".", 1)[-1]
+            if tail == "output_folder":
+                return node.right.value
+    return None
+
+
+def _fstring_template(node: ast.JoinedStr) -> Optional[Tuple[int, str, str]]:
+    """(field count, suffix, rendered text) for artifact-shaped f-strings."""
+    if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+        return None
+    rendered: List[str] = []
+    for value in node.values:
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            rendered.append(value.value)
+        elif isinstance(value, ast.FormattedValue):
+            rendered.append("\x00")  # one placeholder = one field
+    text = "".join(rendered)
+    if " " in text or "/" in text:
+        return None
+    # Regex patterns built as f-strings (reader-side matching) are not name
+    # templates, and a real artifact name never has empty `_` fields.
+    if any(ch in text for ch in "\\()[]*+?^$|"):
+        return None
+    m = _SUFFIX_RE.search(text)
+    suffix = ""
+    stem = text
+    if m and not m.group(1)[1:].isdigit():
+        suffix = m.group(1)
+        stem = text[: -len(suffix)]
+    parts = stem.split("_")
+    if any(not p for p in parts):
+        return None
+    fields = len(parts)
+    if suffix not in ARTIFACT_SUFFIXES and not (suffix == "" and fields >= 3):
+        return None
+    return fields, suffix, text.replace("\x00", "{}")
+
+
+def _collect(modules: Sequence[ModuleInfo]):
+    """(bus uses, templates) across all writer/reader modules."""
+    uses: List[_BusUse] = []
+    templates: List[_Template] = []
+    for module in modules:
+        side = _side(module.relpath)
+        if side is None:
+            continue
+        aliases = import_aliases(module.tree)
+        parents = parent_map(module.tree)
+        scope_buses: Dict[Optional[ast.AST], List[_BusUse]] = {}
+        scope_templates: Dict[Optional[ast.AST], List[Tuple[int, str, int, str]]] = {}
+        for node in ast.walk(module.tree):
+            bus = None
+            if isinstance(node, ast.Call):
+                bus = _bus_name_from_call(node, aliases)
+            elif isinstance(node, ast.BinOp):
+                bus = _bus_name_from_binop(node, aliases)
+            if bus is not None:
+                use = _BusUse(bus=bus, relpath=module.relpath, line=node.lineno)
+                uses.append(use)
+                scope_buses.setdefault(
+                    _enclosing_function(node, parents), []
+                ).append(use)
+            elif isinstance(node, ast.JoinedStr):
+                t = _fstring_template(node)
+                if t is not None:
+                    scope_templates.setdefault(
+                        _enclosing_function(node, parents), []
+                    ).append((t[0], t[1], node.lineno, t[2]))
+        for scope, found in scope_templates.items():
+            for bus_use in scope_buses.get(scope, []):
+                for fields, suffix, line, text in found:
+                    templates.append(
+                        _Template(
+                            bus=bus_use.bus,
+                            fields=fields,
+                            suffix=suffix,
+                            relpath=module.relpath,
+                            line=line,
+                            text=text,
+                        )
+                    )
+    return uses, templates
+
+
+def _side(relpath: str) -> Optional[str]:
+    if relpath.startswith(WRITER_PREFIXES):
+        return "writer"
+    if relpath.startswith(READER_PREFIXES):
+        return "reader"
+    return None
+
+
+@register
+class ArtifactContractRule(Rule):
+    """Cross-check the engine→plotters filesystem artifact contract."""
+
+    name = "artifact-contract"
+    description = (
+        "every artifact bus engine/ writes must have a reader in "
+        "plotters//utils/ (and vice versa), with compatible filename "
+        "templates (suffix + field arity)"
+    )
+
+    def check_package(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Tuple[str, int, str]]:
+        uses, templates = _collect(modules)
+        if not uses:
+            return
+
+        writer_buses: Dict[str, _BusUse] = {}
+        reader_buses: Dict[str, _BusUse] = {}
+        for use in uses:
+            side = _side(use.relpath)
+            target = writer_buses if side == "writer" else reader_buses
+            target.setdefault(use.bus, use)
+
+        for bus, use in sorted(writer_buses.items()):
+            if bus in EXEMPT_BUSES or bus in reader_buses:
+                continue
+            yield use.relpath, use.line, (
+                f"engine writes artifact bus `{bus}` but no plotters/utils "
+                "module reads it: orphaned artifacts (add a reader or exempt "
+                "the bus)"
+            )
+        for bus, use in sorted(reader_buses.items()):
+            if bus in EXEMPT_BUSES or bus in writer_buses:
+                continue
+            yield use.relpath, use.line, (
+                f"`{bus}` is read by aggregation but no engine module writes "
+                "it: the reader can only ever see an empty bus"
+            )
+
+        writer_templates: Dict[str, List[_Template]] = {}
+        reader_templates: Dict[str, List[_Template]] = {}
+        for t in templates:
+            if t.bus in EXEMPT_BUSES:
+                continue
+            side = _side(t.relpath)
+            bucket = writer_templates if side == "writer" else reader_templates
+            bucket.setdefault(t.bus, []).append(t)
+
+        for bus, readers in sorted(reader_templates.items()):
+            writers = writer_templates.get(bus)
+            if not writers:
+                continue
+            for rt in readers:
+                if not any(
+                    wt.suffix == rt.suffix and wt.fields >= rt.fields
+                    for wt in writers
+                ):
+                    options = ", ".join(
+                        sorted({f"{wt.text} ({wt.relpath})" for wt in writers})
+                    )
+                    yield rt.relpath, rt.line, (
+                        f"reader template `{rt.text}` on bus `{bus}` matches "
+                        f"no writer template (writers emit: {options}): "
+                        "filename contract drift"
+                    )
+        for bus, writers in sorted(writer_templates.items()):
+            readers = reader_templates.get(bus)
+            if not readers:
+                continue
+            for wt in writers:
+                if not any(
+                    wt.suffix == rt.suffix and wt.fields >= rt.fields
+                    for rt in readers
+                ):
+                    options = ", ".join(
+                        sorted({f"{rt.text} ({rt.relpath})" for rt in readers})
+                    )
+                    yield wt.relpath, wt.line, (
+                        f"writer template `{wt.text}` on bus `{bus}` is "
+                        f"parseable by no reader template (readers expect: "
+                        f"{options}): filename contract drift"
+                    )

@@ -1,0 +1,131 @@
+"""Rule ``buffer-donation``: state-threading jits must donate their buffers.
+
+A jitted step of the form ``state' = step(state, ...)`` holds BOTH the old
+and new state alive across the call unless the old buffers are donated
+(``donate_argnums``). For the ensemble trainers here the state is a stacked
+multi-member parameter+optimizer pytree — multi-GB at paper scale — so a
+missing donation doubles peak HBM and halves the trainable ensemble width.
+
+Detection: every ``jax.jit`` application (decorator, direct call, or
+``functools.partial(jax.jit, ...)``) whose wrapped callable is resolvable in
+the module (a local ``def`` referenced by name, or an inline ``lambda``) and
+whose parameter names include a state-carrier (``opt_state``, ``state``,
+``carry``, ``opt_states``) is flagged unless the jit supplies
+``donate_argnums``/``donate_argnames``. Inference-only jits (``params`` with
+no optimizer state) are exempt: their parameters are reused across calls and
+must NOT be donated.
+"""
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.common import (
+    callee_name,
+    dotted,
+    import_aliases,
+    is_partial_of,
+    lambda_or_def_params,
+    resolve_local_function,
+)
+
+#: Parameter names that mark a jitted callable as a state-threading step.
+STATE_PARAM_NAMES = {"opt_state", "opt_states", "state", "carry"}
+
+_JIT_NAMES = ("jax.jit", "jax.pjit", "jax.experimental.pjit.pjit")
+
+
+def _jit_donates(keywords: List[ast.keyword]) -> bool:
+    return any(
+        kw.arg in ("donate_argnums", "donate_argnames") for kw in keywords
+    )
+
+
+@register
+class BufferDonationRule(Rule):
+    """Flag state-threading jit applications without donate_argnums."""
+
+    name = "buffer-donation"
+    description = (
+        "jitted state-threading steps (params/opt_state style) without "
+        "donate_argnums: old and new state both stay alive, doubling peak HBM"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        aliases = import_aliases(module.tree)
+
+        # Form 1: decorators on defs.
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                verdict = self._decorator_misses_donation(dec, aliases)
+                if verdict and self._state_params(node):
+                    yield "", node.lineno, self._message(node.name, node)
+                    break
+
+        # Form 2: call application — jax.jit(f), jax.jit(lambda ...),
+        # partial(jax.jit, ...)(f).
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            wrapped = self._jit_application_without_donation(node, aliases)
+            if wrapped is None:
+                continue
+            fn = self._resolve_callable(wrapped, module, aliases)
+            if fn is None:
+                continue
+            if self._state_params(fn):
+                label = getattr(fn, "name", "<lambda>")
+                yield "", node.lineno, self._message(label, fn)
+
+    def _message(self, label: str, fn) -> str:
+        params = [p for p in lambda_or_def_params(fn) if p in STATE_PARAM_NAMES]
+        return (
+            f"jitted state-threading step `{label}` (carries {', '.join(params)}) "
+            "has no donate_argnums: old and new state both stay alive across "
+            "the call"
+        )
+
+    def _state_params(self, fn) -> bool:
+        return bool(set(lambda_or_def_params(fn)) & STATE_PARAM_NAMES)
+
+    def _decorator_misses_donation(self, dec: ast.AST, aliases) -> bool:
+        """True when this decorator is a jit application without donation."""
+        if dotted(dec, aliases) in _JIT_NAMES:
+            return True  # bare @jax.jit: no kwargs at all
+        if isinstance(dec, ast.Call):
+            name = callee_name(dec, aliases)
+            if name in _JIT_NAMES:
+                return not _jit_donates(dec.keywords)
+            for jit in _JIT_NAMES:
+                if is_partial_of(dec, jit, aliases):
+                    return not _jit_donates(dec.keywords)
+        return False
+
+    def _jit_application_without_donation(
+        self, call: ast.Call, aliases
+    ) -> Optional[ast.AST]:
+        """The callable expression a donation-less jit wraps, else None."""
+        name = callee_name(call, aliases)
+        if name in _JIT_NAMES and call.args:
+            if not _jit_donates(call.keywords):
+                return call.args[0]
+            return None
+        # partial(jax.jit, ...)(f)
+        if isinstance(call.func, ast.Call) and call.args:
+            inner = call.func
+            for jit in _JIT_NAMES:
+                if is_partial_of(inner, jit, aliases):
+                    if not _jit_donates(inner.keywords):
+                        return call.args[0]
+                    return None
+        return None
+
+    def _resolve_callable(self, expr: ast.AST, module: ModuleInfo, aliases):
+        """Lambda directly, or a module-local def referenced by bare name."""
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if isinstance(expr, ast.Name):
+            return resolve_local_function(expr.id, module.tree)
+        return None

@@ -1,0 +1,231 @@
+"""Shared AST machinery for the JAX-aware tiplint rules.
+
+Provides import-alias resolution (``jnp`` -> ``jax.numpy``), dotted-name
+rendering for call/attribute chains, and the *jit-reachability* analysis that
+decides which function bodies are traced device code.
+
+Jit-reachability is an intentionally local, syntactic over/under-approximation
+(no call-graph, no cross-module dataflow). A function is jit-reachable when:
+
+1. it is decorated with a JAX transform (``@jax.jit``, ``@jax.vmap``,
+   ``@functools.partial(jax.jit, ...)``, ...);
+2. it (or a lambda) is passed by name into a transform call in the same
+   module (``jax.jit(f)``, ``jax.vmap(f)``, ``jax.lax.scan(step, ...)``);
+3. its body uses ``jax.lax`` control flow (``scan``/``while_loop``/
+   ``fori_loop``/``cond``/``map``) — functions structured around lax control
+   flow are device code even when the jit wrapper is applied by a factory in
+   another function (the ``make_epoch_core`` pattern in models/train.py);
+4. it is nested inside a jit-reachable function.
+"""
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple, Union
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+#: Canonical names whose call traces the callable passed to them.
+TRANSFORM_CALLEES = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.experimental.pjit.pjit",
+    "jax.lax.scan",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.cond",
+    "jax.lax.map",
+    "jax.lax.switch",
+}
+
+#: lax control-flow callees whose presence marks the *enclosing* function as
+#: device code (heuristic 3 above).
+LAX_CONTROL_FLOW = {
+    "jax.lax.scan",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.cond",
+    "jax.lax.map",
+    "jax.lax.switch",
+}
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """local name -> canonical dotted module/object path, from all imports.
+
+    ``import jax.numpy as jnp`` maps ``jnp -> jax.numpy``; ``from jax import
+    random`` maps ``random -> jax.random``; ``from functools import partial``
+    maps ``partial -> functools.partial``. Imports anywhere in the file count
+    (this codebase imports jax lazily inside functions by design).
+    """
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Canonical dotted name of a Name/Attribute chain, or None.
+
+    ``jnp.sqrt`` -> ``jax.numpy.sqrt`` under ``import jax.numpy as jnp``.
+    """
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    head = aliases.get(node.id, node.id)
+    parts.append(head)
+    return ".".join(reversed(parts))
+
+
+def callee_name(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    """Canonical dotted name of a call's target (None for computed callees)."""
+    return dotted(call.func, aliases)
+
+
+def is_partial_of(call: ast.Call, target: str, aliases: Dict[str, str]) -> bool:
+    """True for ``functools.partial(<target>, ...)`` call expressions."""
+    name = callee_name(call, aliases)
+    if name not in ("functools.partial", "partial"):
+        return False
+    return bool(call.args) and dotted(call.args[0], aliases) == target
+
+
+def _transform_target(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    """Does this decorator/callee expression denote a JAX transform?"""
+    name = dotted(node, aliases)
+    if name in TRANSFORM_CALLEES:
+        return True
+    if isinstance(node, ast.Call):
+        # @partial(jax.jit, ...) / partial(jax.vmap, ...)(f)
+        for t in TRANSFORM_CALLEES:
+            if is_partial_of(node, t, aliases):
+                return True
+        # @jax.jit(static_argnames=...) — a transform called with config only
+        inner = callee_name(node, aliases)
+        if inner in TRANSFORM_CALLEES:
+            return True
+    return False
+
+
+def parent_map(tree: ast.Module) -> Dict[ast.AST, ast.AST]:
+    """child node -> parent node for the whole tree."""
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def jit_reachable_functions(
+    tree: ast.Module, aliases: Dict[str, str]
+) -> Set[FunctionNode]:
+    """The set of function/lambda nodes considered traced device code."""
+    parents = parent_map(tree)
+    defs_by_name: Dict[str, List[FunctionNode]] = {}
+    all_funcs: List[FunctionNode] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+            all_funcs.append(node)
+        elif isinstance(node, ast.Lambda):
+            all_funcs.append(node)
+
+    reachable: Set[FunctionNode] = set()
+
+    # (1) decorated with a transform
+    for fn in all_funcs:
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_transform_target(d, aliases) for d in fn.decorator_list):
+                reachable.add(fn)
+
+    # (2) passed (by name or inline) into a transform call
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _transform_target(node.func, aliases):
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Lambda):
+                reachable.add(arg)
+            elif isinstance(arg, ast.Name):
+                for fn in defs_by_name.get(arg.id, []):
+                    reachable.add(fn)
+
+    # (3) body uses lax control flow
+    for fn in all_funcs:
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    if callee_name(node, aliases) in LAX_CONTROL_FLOW:
+                        reachable.add(fn)
+
+    # (4) nested defs inside reachable functions
+    changed = True
+    while changed:
+        changed = False
+        for fn in all_funcs:
+            if fn in reachable:
+                continue
+            node: Optional[ast.AST] = parents.get(fn)
+            while node is not None:
+                if node in reachable:
+                    reachable.add(fn)
+                    changed = True
+                    break
+                node = parents.get(node)
+
+    return reachable
+
+
+def function_body_nodes(fn: FunctionNode) -> Iterator[ast.AST]:
+    """Walk every node of a function body (the def node itself excluded)."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        yield from ast.walk(stmt)
+
+
+def resolve_local_function(
+    name: str, tree: ast.Module
+) -> Optional[Union[ast.FunctionDef, ast.AsyncFunctionDef]]:
+    """A def with this name anywhere in the module (first match), or None."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name == name:
+                return node
+    return None
+
+
+def lambda_or_def_params(fn: FunctionNode) -> List[str]:
+    """Positional/keyword parameter names of a function or lambda."""
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def contains_jnp(node: ast.AST, aliases: Dict[str, str]) -> Optional[Tuple[int, str]]:
+    """(line, dotted name) of the first jax/jnp reference inside ``node``."""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Attribute, ast.Name)):
+            name = dotted(sub, aliases)
+            if name and (name.startswith("jax.numpy.") or name == "jax.numpy"):
+                return getattr(sub, "lineno", 0), name
+    return None

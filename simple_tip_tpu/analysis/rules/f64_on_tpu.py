@@ -1,0 +1,72 @@
+"""Rule ``f64-on-tpu``: float64 in device-adjacent modules downcasts on TPU.
+
+TPUs have no native f64: without ``jax_enable_x64`` a ``float64`` request
+silently becomes f32 on device, and with it, emulated f64 is an order of
+magnitude slower. Host-side numpy f64 is legitimate where exactness parity
+with the reference matters (the KDE in ``ops/kde.py`` is the documented
+example — README "Architecture"), but every such site must be explicit: an
+allowlisted module or an inline suppression with a justification comment,
+so a future device-migration sweep can find them all.
+
+Flags, in device-adjacent modules (``ops/``, ``parallel/``, ``models/``,
+``engine/``, ``casestudies/``) outside the allowlist:
+
+- any ``<x>.float64`` attribute (``np.float64``, ``jnp.float64``);
+- any ``"float64"``/``"f64"`` string literal used as a call argument or in
+  a comparison (dtype strings), excluding docstrings.
+"""
+
+import ast
+from typing import Iterator, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+
+#: Module prefixes where f64 matters (device-adjacent code).
+DEVICE_ADJACENT_PREFIXES = (
+    "ops/",
+    "parallel/",
+    "models/",
+    "engine/",
+    "casestudies/",
+)
+
+#: Modules whose f64 is wholesale intentional (host-exactness by design).
+ALLOWLIST = ("ops/kde.py",)
+
+_DTYPE_STRINGS = {"float64", "f64"}
+
+
+@register
+class F64OnTpuRule(Rule):
+    """Flag float64 dtypes outside the explicit host-f64 allowlist."""
+
+    name = "f64-on-tpu"
+    description = (
+        "float64 dtype usage in device-adjacent modules (TPUs have no "
+        "native f64; requests silently downcast) outside the allowlist"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        if not module.relpath.startswith(DEVICE_ADJACENT_PREFIXES):
+            return
+        if module.relpath in ALLOWLIST:
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "float64":
+                yield "", node.lineno, (
+                    "float64 dtype in a device-adjacent module: TPUs have no "
+                    "native f64 (silent downcast to f32); use f32/bf16 on "
+                    "device, or suppress with a host-exactness justification"
+                )
+            elif isinstance(node, ast.Call):
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if (
+                        isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and arg.value in _DTYPE_STRINGS
+                    ):
+                        yield "", arg.lineno, (
+                            f'dtype string "{arg.value}" in a device-adjacent '
+                            "module: TPUs have no native f64 (silent downcast "
+                            "to f32)"
+                        )

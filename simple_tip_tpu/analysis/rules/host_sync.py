@@ -1,0 +1,87 @@
+"""Rule ``host-sync``: implicit device→host transfers in hot-path modules.
+
+On TPU, every ``np.asarray(jnp_value)`` / ``np.array(jnp_value)`` blocks the
+Python thread until the device catches up and then DMAs the buffer to host —
+fine at a phase boundary, lethal inside a per-badge or per-batch loop. The
+hot-path modules (``ops/``, ``parallel/``, ``engine/``) are exactly where
+such syncs hide, so the rule is scoped to them; plotters and data prep are
+host code by design.
+
+Flags, in hot-path modules only:
+
+- ``np.asarray(...)``/``np.array(...)`` whose argument expression itself
+  builds a device value (contains a ``jax.numpy``/``jnp`` reference): the
+  device result is synced to host the moment it is produced. Hoist the
+  conversion to the phase boundary (and suppress with a justification when
+  the sync IS the phase boundary).
+- ``if``/``while`` tests containing a ``jax.numpy`` call inside a traced
+  function: branching on a traced value concretizes it (TracerBoolError at
+  best, a silent sync under ``io_callback``-style wrappers at worst).
+"""
+
+import ast
+from typing import Iterator, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.common import (
+    callee_name,
+    contains_jnp,
+    function_body_nodes,
+    import_aliases,
+    jit_reachable_functions,
+)
+
+#: Module prefixes (relative to the analyzed root) treated as hot paths.
+HOT_PATH_PREFIXES = ("ops/", "parallel/", "engine/")
+
+_CONVERTERS = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
+
+
+@register
+class HostSyncRule(Rule):
+    """Flag implicit device→host syncs in ops/, parallel/ and engine/."""
+
+    name = "host-sync"
+    description = (
+        "np.asarray/np.array on freshly-built jax values and branches on "
+        "traced values in hot-path modules (ops/, parallel/, engine/)"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        if not module.relpath.startswith(HOT_PATH_PREFIXES):
+            return
+        aliases = import_aliases(module.tree)
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = callee_name(node, aliases)
+            if name in _CONVERTERS and node.args:
+                hit = contains_jnp(node.args[0], aliases)
+                if hit is not None:
+                    yield "", node.lineno, (
+                        f"{name.replace('numpy', 'np')}() over a fresh device "
+                        f"value ({hit[1]} at line {hit[0]}): implicit "
+                        "device->host sync; hoist the transfer to the phase "
+                        "boundary"
+                    )
+
+        reachable = jit_reachable_functions(module.tree, aliases)
+        seen = set()
+        for fn in reachable:
+            for node in function_body_nodes(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                if node.lineno in seen:
+                    continue
+                for sub in ast.walk(node.test):
+                    if isinstance(sub, ast.Call):
+                        sub_name = callee_name(sub, aliases)
+                        if sub_name and sub_name.startswith("jax.numpy."):
+                            seen.add(node.lineno)
+                            yield "", node.lineno, (
+                                f"branching on a traced value ({sub_name}) "
+                                "inside a traced function forces "
+                                "concretization; use jax.lax.cond/jnp.where"
+                            )
+                            break

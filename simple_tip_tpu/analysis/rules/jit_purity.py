@@ -1,0 +1,113 @@
+"""Rule ``jit-purity``: side effects and concretization inside traced code.
+
+A jitted function executes its Python body ONCE at trace time; ``print``,
+global mutation and host-library calls silently run on the wrong schedule (or
+not at all on cache hits), and ``.item()``/``float()``/``int()``/``bool()``
+force a device→host sync that blocks the XLA pipeline mid-program. All of
+these are trace-time bugs the runtime never reports.
+
+Flags, inside jit-reachable functions (see ``common.jit_reachable_functions``):
+
+- ``print(...)`` calls (use ``jax.debug.print`` while debugging — and remove
+  it before shipping; leftover ``jax.debug.*`` is flagged too);
+- ``global``/``nonlocal`` declarations (impure closure mutation);
+- ``np.*``/``numpy.*``/``scipy.*`` calls (host library inside device code —
+  breaks tracing or silently falls back to host);
+- ``.item()`` calls and ``float()``/``int()``/``bool()`` casts on traced
+  values (concretization; casts of ``.shape`` components are static and
+  exempt);
+- ``jax.debug.print``/``jax.debug.breakpoint`` leftovers.
+"""
+
+import ast
+from typing import Iterator, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.common import (
+    callee_name,
+    function_body_nodes,
+    import_aliases,
+    jit_reachable_functions,
+)
+
+_HOST_LIB_PREFIXES = ("numpy.", "scipy.")
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+
+
+def _is_static_shape_expr(node: ast.AST) -> bool:
+    """``int(x.shape[0])``-style casts are trace-time static, not syncs."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim", "size", "dtype"):
+            return True
+        if isinstance(sub, ast.Constant):
+            return True
+    return False
+
+
+@register
+class JitPurityRule(Rule):
+    """Flag impure / concretizing constructs inside traced functions."""
+
+    name = "jit-purity"
+    description = (
+        "print, global/nonlocal mutation, numpy/scipy calls, "
+        ".item()/float()/int()/bool() concretization and jax.debug leftovers "
+        "inside jit/vmap/scan-traced functions"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        aliases = import_aliases(module.tree)
+        reachable = jit_reachable_functions(module.tree, aliases)
+        seen = set()
+        for fn in reachable:
+            for node in function_body_nodes(fn):
+                for finding in self._check_node(node, aliases):
+                    key = finding[:2]
+                    if key not in seen:
+                        seen.add(key)
+                        yield finding
+
+    def _check_node(self, node, aliases):
+        rel = ""  # filled in by the driver (relpath comes from the module)
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+            yield rel, node.lineno, (
+                f"`{kind} {', '.join(node.names)}` inside a traced function: "
+                "closure mutation runs at trace time only"
+            )
+            return
+        if not isinstance(node, ast.Call):
+            return
+        name = callee_name(node, aliases)
+        if name == "print":
+            yield rel, node.lineno, (
+                "print() inside a traced function executes at trace time "
+                "only; use jax.debug.print while debugging"
+            )
+        elif name is not None and name.startswith("jax.debug."):
+            yield rel, node.lineno, (
+                f"{name}() left in traced code: debug callbacks stall the "
+                "device pipeline in production"
+            )
+        elif name is not None and name.startswith(_HOST_LIB_PREFIXES):
+            # Host-library math over static shape metadata (np.sqrt(x.shape[-1])
+            # and friends) happens once at trace time and is pure — exempt.
+            if node.args and all(_is_static_shape_expr(a) for a in node.args):
+                return
+            yield rel, node.lineno, (
+                f"host-library call {name}() inside a traced function: "
+                "use jax.numpy, or move the call outside jit"
+            )
+        elif name in _CAST_BUILTINS:
+            if node.args and not any(
+                _is_static_shape_expr(a) for a in node.args
+            ):
+                yield rel, node.lineno, (
+                    f"{name}() on a traced value forces a device->host sync "
+                    "inside the program; keep it as a jax array"
+                )
+        elif isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            yield rel, node.lineno, (
+                ".item() inside a traced function concretizes a traced "
+                "value; return the array and read it on host"
+            )

@@ -1,0 +1,153 @@
+"""Rule ``prng-hygiene``: a PRNG key consumed twice produces identical draws.
+
+JAX keys are values, not stateful generators: passing the same key to two
+samplers yields the SAME randomness — statistically catastrophic and silent
+(dropout masks repeat, ensemble members correlate). The fix is always a
+``jax.random.split``/``fold_in`` re-derivation between uses.
+
+Detection is a per-function-scope linear scan: a name becomes *consumed* when
+passed as the key (first positional) argument to a ``jax.random.*`` sampler
+or to ``split``; consuming an already-consumed name is a finding. Rebinding
+the name (``rng, sub = jax.random.split(rng)``) makes it fresh again.
+``fold_in(key, data)`` is exempt on both sides: deriving several streams from
+one key with distinct fold data is the canonical loop idiom in this codebase
+(models/train.py ``mc_dropout_votes``).
+
+Loop bodies are scanned twice, so a consume-without-rebind inside ``for``/
+``while`` is caught as the cross-iteration reuse it is; ``if`` branches are
+scanned against copies of the state and merged (exclusive branches may both
+consume the same key).
+"""
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.common import callee_name, import_aliases
+
+#: jax.random functions that do NOT consume their key argument.
+_NON_CONSUMING = {
+    "jax.random.PRNGKey",
+    "jax.random.key",
+    "jax.random.fold_in",
+    "jax.random.key_data",
+    "jax.random.wrap_key_data",
+}
+
+_SKIP_SUBTREES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _walk_same_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nested functions/classes/lambdas."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, _SKIP_SUBTREES):
+            continue
+        yield sub
+        stack.extend(ast.iter_child_nodes(sub))
+
+
+def _assigned_names(target: ast.AST) -> List[str]:
+    return [n.id for n in ast.walk(target) if isinstance(n, ast.Name)]
+
+
+@register
+class PrngHygieneRule(Rule):
+    """Flag PRNG keys used twice without an intervening split/fold_in."""
+
+    name = "prng-hygiene"
+    description = (
+        "a PRNG key passed to two jax.random consumers without an "
+        "intervening split/fold_in re-derivation"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        aliases = import_aliases(module.tree)
+        scopes: List[List[ast.stmt]] = [module.tree.body]
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        reported: Set[int] = set()
+        for body in scopes:
+            for line, msg in self._scan(body, aliases, {}):
+                if line not in reported:
+                    reported.add(line)
+                    yield "", line, msg
+
+    def _scan(
+        self, body: List[ast.stmt], aliases, consumed: Dict[str, int]
+    ) -> Iterator[Tuple[int, str]]:
+        """Walk statements in order, threading the consumed-key state."""
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested scopes are scanned independently
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                yield from self._uses(stmt.iter, aliases, consumed)
+                for name in _assigned_names(stmt.target):
+                    consumed.pop(name, None)
+                # Two passes: a key consumed in pass 1 and not rebound is the
+                # cross-iteration reuse pass 2 reports.
+                yield from self._scan(stmt.body, aliases, consumed)
+                yield from self._scan(stmt.body, aliases, consumed)
+                yield from self._scan(stmt.orelse, aliases, consumed)
+            elif isinstance(stmt, ast.While):
+                yield from self._uses(stmt.test, aliases, consumed)
+                yield from self._scan(stmt.body, aliases, consumed)
+                yield from self._scan(stmt.body, aliases, consumed)
+                yield from self._scan(stmt.orelse, aliases, consumed)
+            elif isinstance(stmt, ast.If):
+                yield from self._uses(stmt.test, aliases, consumed)
+                then_state = dict(consumed)
+                else_state = dict(consumed)
+                yield from self._scan(stmt.body, aliases, then_state)
+                yield from self._scan(stmt.orelse, aliases, else_state)
+                consumed.clear()
+                consumed.update(then_state)
+                consumed.update(else_state)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    yield from self._uses(item.context_expr, aliases, consumed)
+                yield from self._scan(stmt.body, aliases, consumed)
+            elif isinstance(stmt, ast.Try):
+                yield from self._scan(stmt.body, aliases, consumed)
+                for handler in stmt.handlers:
+                    yield from self._scan(handler.body, aliases, consumed)
+                yield from self._scan(stmt.orelse, aliases, consumed)
+                yield from self._scan(stmt.finalbody, aliases, consumed)
+            else:
+                yield from self._uses(stmt, aliases, consumed)
+                targets: List[ast.AST] = []
+                if isinstance(stmt, ast.Assign):
+                    targets = stmt.targets
+                elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [stmt.target]
+                for target in targets:
+                    for name in _assigned_names(target):
+                        consumed.pop(name, None)
+
+    def _uses(
+        self, node: ast.AST, aliases, consumed: Dict[str, int]
+    ) -> Iterator[Tuple[int, str]]:
+        """Record every key-consuming jax.random call under ``node``."""
+        calls = [node] if isinstance(node, ast.Call) else []
+        calls += [n for n in _walk_same_scope(node) if isinstance(n, ast.Call)]
+        # Source order: nested calls evaluate inner-first, but for reuse
+        # reporting, line order reads best.
+        calls.sort(key=lambda c: (c.lineno, c.col_offset))
+        for call in calls:
+            name = callee_name(call, aliases)
+            if not name or not name.startswith("jax.random."):
+                continue
+            if name in _NON_CONSUMING:
+                continue
+            if not call.args or not isinstance(call.args[0], ast.Name):
+                continue
+            key = call.args[0].id
+            if key in consumed:
+                yield call.lineno, (
+                    f"PRNG key `{key}` reused by {name}() (already consumed "
+                    f"on line {consumed[key]}); derive a fresh key with "
+                    "jax.random.split or fold_in"
+                )
+            consumed[key] = call.lineno

@@ -302,7 +302,7 @@ class GaussianMixture:
         )
         if finite:
             try:
-                np.linalg.cholesky(self.covariances_.astype(np.float64))
+                np.linalg.cholesky(self.covariances_.astype(np.float64))  # tiplint: disable=f64-on-tpu (host sklearn-parity PSD probe)
             except np.linalg.LinAlgError:
                 finite = False
         if not finite:
@@ -316,11 +316,11 @@ class GaussianMixture:
     def _weighted_log_prob(self, x: np.ndarray) -> np.ndarray:
         import scipy.linalg
 
-        x = np.asarray(x, dtype=np.float64)
+        x = np.asarray(x, dtype=np.float64)  # tiplint: disable=f64-on-tpu (host GMM scoring; sklearn numeric parity)
         n, d = x.shape
         out = np.empty((n, self.n_components))
         for k in range(self.n_components):
-            cov = self.covariances_[k].astype(np.float64)
+            cov = self.covariances_[k].astype(np.float64)  # tiplint: disable=f64-on-tpu (host cholesky: the numerically delicate step stays f64)
             chol = np.linalg.cholesky(cov + np.eye(d) * 1e-12)
             diff = (x - self.means_[k]).T  # [d, n]
             sol = scipy.linalg.solve_triangular(chol, diff, lower=True)

@@ -137,7 +137,7 @@ class PallasDSABackend:
         """DSA = a_dist / b_dist per query row (chunked kernel launches)."""
         n_test = target_ats.shape[0]
         d = target_ats.shape[1]
-        out = np.empty(n_test, np.float64)
+        out = np.empty(n_test, np.float64)  # tiplint: disable=f64-on-tpu (host result buffer; DSA score dtype parity with ops/surprise.py)
         for start in range(0, n_test, CHUNK):
             xb = target_ats[start : start + CHUNK].astype(np.float32)
             lb = target_pred[start : start + CHUNK]

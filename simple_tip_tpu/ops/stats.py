@@ -29,7 +29,7 @@ class _Welford:
         self.m2 = None
 
     def add_all(self, batch: np.ndarray):
-        batch = np.asarray(batch, dtype=np.float64)
+        batch = np.asarray(batch, dtype=np.float64)  # tiplint: disable=f64-on-tpu (host-parity Welford; device path is DeviceAggregateStatisticsCollector)
         b_count = batch.shape[0]
         if b_count == 0:
             return
@@ -151,12 +151,16 @@ class DeviceAggregateStatisticsCollector:
                 m2 + b_m2 + delta**2 * (cnt * b_cnt / total),
             )
 
-        # One fused dispatch per badge over the whole layer list.
+        # One fused dispatch per badge over the whole layer list. The running
+        # state is replaced on every fold, so its old buffers are donated —
+        # without donation both generations stay alive across the call
+        # (flagged by tiplint buffer-donation).
         self._init_layer = jax.jit(lambda badge: [_one_init(b) for b in badge])
         self._update_layer = jax.jit(
             lambda state, badge: [
                 _one_update(s, b) for s, b in zip(state, badge)
-            ]
+            ],
+            donate_argnums=(0,),
         )
 
     def track(self, badge) -> None:
@@ -188,6 +192,7 @@ class DeviceAggregateStatisticsCollector:
         mins = [np.asarray(s[0]) for s in self._state]
         maxs = [np.asarray(s[1]) for s in self._state]
         stds = [
+            # tiplint: disable=host-sync (get() IS the phase boundary: one transfer per collection)
             np.asarray(jnp.sqrt(s[4] / (np.asarray(s[2]) - 1)).reshape(s[0].shape))
             for s in self._state
         ]
@@ -242,6 +247,7 @@ def aggregate_over_batches(layer_batches_iter):
     mins = [np.asarray(s[0]) for s in state]
     maxs = [np.asarray(s[1]) for s in state]
     stds = [
+        # tiplint: disable=host-sync (terminal transfer: host results once per aggregation)
         np.asarray(jnp.sqrt(s[4] / (s[2] - 1)).reshape(s[0].shape)) for s in state
     ]
     return mins, maxs, stds

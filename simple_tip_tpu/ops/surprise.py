@@ -241,6 +241,7 @@ class SurpriseCoverageMapper:
         self.upper_bound = upper_bound
         linspace_sections = sections if overflow_bucket else sections + 1
         self.thresholds = np.linspace(
+            # tiplint: disable=f64-on-tpu (host bucketing; threshold parity with the reference's numpy)
             start=0, stop=upper_bound, num=linspace_sections, dtype=np.float64
         )
         if overflow_bucket:
@@ -408,12 +409,12 @@ class MDSA(SA):
         # (tests/test_surprise.py::test_mdsa_f32_ordering_parity_at_scale)
         # — f32 can still swap scores tied within ~1e-4 relative.
         activations = _flatten_layers(activations).astype(np.float32)
-        self.location = activations.mean(axis=0, dtype=np.float64).astype(
+        self.location = activations.mean(axis=0, dtype=np.float64).astype(  # tiplint: disable=f64-on-tpu (host mean accumulator; see block comment above)
             np.float32
         )
         # ML (biased) covariance — matches sklearn EmpiricalCovariance.
         centered = activations - self.location
-        self.covariance = (centered.T @ centered).astype(np.float64) / activations.shape[0]
+        self.covariance = (centered.T @ centered).astype(np.float64) / activations.shape[0]  # tiplint: disable=f64-on-tpu (host covariance; pinvh is the numerically delicate step)
         self.precision = scipy.linalg.pinvh(np.atleast_2d(self.covariance)).astype(
             np.float32
         )
@@ -431,6 +432,7 @@ class MDSA(SA):
         # over f32 gemm outputs: the final dot's additions are where
         # cancellation could reorder near-ties.
         return np.einsum(
+            # tiplint: disable=f64-on-tpu (host f64 row reduction over f32 gemm; see comment above)
             "ij,ij->i", (centered @ self.precision).astype(np.float64), centered
         )
 
@@ -779,7 +781,8 @@ class DSA(SA):
         out = np.empty(padded, dtype=np.float32)
         for i in range(n_chunks):
             sl = slice(i * chunk, (i + 1) * chunk)
+            # tiplint: disable=host-sync (bounded-memory streaming: each chunk lands in a preallocated host buffer)
             out[sl] = np.asarray(
                 dsa_chunk(jnp.asarray(target_ats[sl]), jnp.asarray(target_pred[sl]))
             )
-        return out[:n_test].astype(np.float64)
+        return out[:n_test].astype(np.float64)  # tiplint: disable=f64-on-tpu (host output dtype parity with the reference's DSA)

@@ -1,0 +1,536 @@
+"""tiplint (simple_tip_tpu.analysis) test suite.
+
+Three layers:
+
+1. per-rule unit tests on deliberately-broken and known-good fixture
+   snippets (every shipped rule must fire on its bad fixture and stay
+   silent on its good one — enforced exhaustively);
+2. framework behavior: suppression comments, JSON/text reporters, CLI exit
+   codes;
+3. the tier-1 gate: the full analyzer over the real package must report
+   ZERO unsuppressed findings.
+
+Pure stdlib on purpose (no jax import): the lint gate must be exercisable
+in dependency-light CI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from simple_tip_tpu.analysis import analyze_paths, all_rules, unsuppressed
+from simple_tip_tpu.analysis.cli import main
+from simple_tip_tpu.analysis.reporters import json_report, text_report
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "simple_tip_tpu")
+
+
+def _write(root, relpath, source):
+    path = os.path.join(root, relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(source)
+    return path
+
+
+def _run_rule(tmp_path, rule, files):
+    root = str(tmp_path / "pkg")
+    for rel, src in files.items():
+        _write(root, rel, src)
+    return unsuppressed(analyze_paths([root], select=[rule]))
+
+
+# --- per-rule fixtures -------------------------------------------------------
+# rule -> (bad files, good files). The exhaustiveness test below requires an
+# entry for every registered rule.
+
+BAD_JIT_PURITY = {
+    "mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x, label):
+    """d."""
+    print("tracing", x)
+    y = np.square(x)
+    z = float(x)
+    w = x.item()
+    jax.debug.print("x={}", x)
+    return y + z + w
+'''
+}
+
+GOOD_JIT_PURITY = {
+    "mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    """Static-shape host math and pure jnp are all fine under trace."""
+    scale = np.float32(1.0 / np.sqrt(x.shape[-1]))
+    n = int(x.shape[0])
+    return jnp.sum(x) * scale + n
+
+
+def host_loop(xs):
+    """print/float outside traced code is host code, not a finding."""
+    for x in xs:
+        print(float(x))
+'''
+}
+
+BAD_PRNG = {
+    "mod.py": '''"""m."""
+import jax
+
+
+def sample(rng):
+    """d."""
+    a = jax.random.normal(rng, (4,))
+    b = jax.random.uniform(rng, (4,))
+    return a + b
+
+
+def loop(rng):
+    """d."""
+    out = []
+    for _ in range(3):
+        out.append(jax.random.normal(rng, (2,)))
+    return out
+'''
+}
+
+GOOD_PRNG = {
+    "mod.py": '''"""m."""
+import jax
+
+
+def sample(rng):
+    """Split before each consumer; fold_in derives per-step streams."""
+    k1, k2 = jax.random.split(rng)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.uniform(k2, (4,))
+    for i in range(3):
+        step = jax.random.fold_in(rng, i)
+        a = a + jax.random.normal(step, (4,))
+    return a + b
+
+
+def rebind(rng):
+    """The split-and-rebind loop idiom is clean."""
+    for _ in range(3):
+        rng, sub = jax.random.split(rng)
+        _ = jax.random.normal(sub, (2,))
+    return rng
+'''
+}
+
+BAD_HOST_SYNC = {
+    "ops/mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def collect(x):
+    """d."""
+    return np.asarray(jnp.sum(x * x))
+
+
+@jax.jit
+def traced(x):
+    """d."""
+    if jnp.any(x > 0):
+        return x
+    return -x
+'''
+}
+
+GOOD_HOST_SYNC = {
+    # identical conversion patterns OUTSIDE hot-path modules are host code
+    "plotters_like/mod.py": '''"""m."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def collect(x):
+    """d."""
+    return np.asarray(jnp.sum(x * x))
+''',
+    "ops/clean.py": '''"""m."""
+import numpy as np
+
+
+def convert(values):
+    """np conversions of host values carry no device sync."""
+    return np.asarray(values, dtype=np.float32)
+''',
+}
+
+BAD_F64 = {
+    "ops/mod.py": '''"""m."""
+import numpy as np
+
+
+def stats(x):
+    """d."""
+    acc = np.zeros(4, dtype=np.float64)
+    return acc + np.asarray(x, dtype="float64")
+'''
+}
+
+GOOD_F64 = {
+    "ops/kde.py": '''"""Allowlisted host-f64 module."""
+import numpy as np
+
+
+def fit(x):
+    """d."""
+    return np.asarray(x, dtype=np.float64)
+''',
+    "plotters/tables.py": '''"""f64 outside device-adjacent modules is host aggregation."""
+import numpy as np
+
+
+def frame(x):
+    """d."""
+    return np.asarray(x, dtype=np.float64)
+''',
+}
+
+BAD_DONATION = {
+    "mod.py": '''"""m."""
+import jax
+
+
+@jax.jit
+def train_step(params, opt_state, batch):
+    """d."""
+    return params, opt_state
+
+
+update = jax.jit(lambda state, delta: state + delta)
+'''
+}
+
+GOOD_DONATION = {
+    "mod.py": '''"""m."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def train_step(params, opt_state, batch):
+    """d."""
+    return params, opt_state
+
+
+update = jax.jit(lambda state, delta: state + delta, donate_argnums=(0,))
+
+
+@jax.jit
+def fwd(params, x):
+    """Inference reuses params across calls; donation would be a bug."""
+    return params, x
+'''
+}
+
+_CONFIG_STUB = '''"""config stub."""
+import os
+
+
+def output_folder():
+    """d."""
+    return os.getcwd()
+
+
+def subdir(name):
+    """d."""
+    return os.path.join(output_folder(), name)
+'''
+
+BAD_CONTRACT = {
+    "config.py": _CONFIG_STUB,
+    "engine/writer.py": '''"""w."""
+import os
+
+from pkg.config import subdir
+
+
+def persist(cs, ds, model, kind, data):
+    """Writes a 2-field name; the reader below expects 4 fields."""
+    with open(os.path.join(subdir("priorities"), f"{cs}_{kind}.npy"), "wb") as f:
+        f.write(data)
+
+
+def persist_orphan(cs, data):
+    """Writes a bus nothing reads."""
+    with open(os.path.join(subdir("orphan_bus"), f"{cs}_{cs}_{cs}.npy"), "wb") as f:
+        f.write(data)
+''',
+    "plotters/reader.py": '''"""r."""
+import os
+
+from pkg.config import output_folder
+
+
+def load(cs, ds, model, kind):
+    """Expects 4 fields on the priorities bus."""
+    folder = os.path.join(output_folder(), "priorities")
+    return os.path.join(folder, f"{cs}_{ds}_{model}_{kind}.npy")
+
+
+def load_ghost():
+    """Reads a bus nothing writes."""
+    return os.path.join(output_folder(), "ghost_bus")
+''',
+}
+
+GOOD_CONTRACT = {
+    "config.py": _CONFIG_STUB,
+    "engine/writer.py": '''"""w."""
+import os
+
+from pkg.config import subdir
+
+
+def persist(cs, ds, model, kind, data):
+    """d."""
+    with open(
+        os.path.join(subdir("priorities"), f"{cs}_{ds}_{model}_{kind}.npy"), "wb"
+    ) as f:
+        f.write(data)
+''',
+    "plotters/reader.py": '''"""r."""
+import os
+
+from pkg.config import output_folder
+
+
+def load(cs, ds, model, kind):
+    """A reader placeholder may absorb several writer fields."""
+    folder = os.path.join(output_folder(), "priorities")
+    return os.path.join(folder, f"{cs}_{ds}_{model}_{kind}.npy")
+''',
+}
+
+BAD_DOCSTRING = {
+    "mod.py": '''import os
+
+
+def alpha():
+    return 1
+
+
+def beta():
+    return 2
+'''
+}
+
+GOOD_DOCSTRING = {
+    "mod.py": '''"""m."""
+
+
+def alpha():
+    """d."""
+    return 1
+''',
+    "__init__.py": "",  # empty namespace init is exempt
+}
+
+FIXTURES = {
+    "jit-purity": (BAD_JIT_PURITY, GOOD_JIT_PURITY),
+    "prng-hygiene": (BAD_PRNG, GOOD_PRNG),
+    "host-sync": (BAD_HOST_SYNC, GOOD_HOST_SYNC),
+    "f64-on-tpu": (BAD_F64, GOOD_F64),
+    "buffer-donation": (BAD_DONATION, GOOD_DONATION),
+    "artifact-contract": (BAD_CONTRACT, GOOD_CONTRACT),
+    "docstring-coverage": (BAD_DOCSTRING, GOOD_DOCSTRING),
+}
+
+
+def test_every_shipped_rule_has_fixtures():
+    assert set(FIXTURES) == set(all_rules()), (
+        "every registered rule needs a bad+good fixture pair in this file"
+    )
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_bad_fixture_triggers_rule(tmp_path, rule):
+    findings = _run_rule(tmp_path, rule, FIXTURES[rule][0])
+    assert findings, f"bad fixture for {rule} produced no findings"
+    assert all(f.rule == rule for f in findings)
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_good_fixture_stays_clean(tmp_path, rule):
+    findings = _run_rule(tmp_path, rule, FIXTURES[rule][1])
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+# --- rule specifics ----------------------------------------------------------
+
+
+def test_jit_purity_finds_each_sin(tmp_path):
+    findings = _run_rule(tmp_path, "jit-purity", BAD_JIT_PURITY)
+    blob = " ".join(f.message for f in findings)
+    for marker in ("print()", "numpy.square", "float()", ".item()", "jax.debug.print"):
+        assert marker in blob, f"missing {marker!r} in: {blob}"
+
+
+def test_prng_loop_reuse_detected(tmp_path):
+    findings = _run_rule(tmp_path, "prng-hygiene", BAD_PRNG)
+    lines = {f.line for f in findings}
+    # line 8: straight-line reuse; line 16: cross-iteration reuse
+    assert len(lines) == 2, findings
+
+
+def test_contract_names_both_orphans(tmp_path):
+    findings = _run_rule(tmp_path, "artifact-contract", BAD_CONTRACT)
+    blob = " ".join(f.message for f in findings)
+    assert "orphan_bus" in blob
+    assert "ghost_bus" in blob
+    assert "contract drift" in blob
+
+
+# --- framework behavior ------------------------------------------------------
+
+
+def test_inline_suppression_downgrades_finding(tmp_path):
+    root = str(tmp_path / "pkg")
+    _write(
+        root,
+        "ops/mod.py",
+        '"""m."""\n'
+        "import numpy as np\n"
+        "acc = np.zeros(4, dtype=np.float64)  # tiplint: disable=f64-on-tpu (host)\n",
+    )
+    findings = analyze_paths([root], select=["f64-on-tpu"])
+    assert len(findings) == 1 and findings[0].suppressed
+    assert not unsuppressed(findings)
+
+
+def test_comment_line_above_suppresses(tmp_path):
+    root = str(tmp_path / "pkg")
+    _write(
+        root,
+        "ops/mod.py",
+        '"""m."""\n'
+        "import numpy as np\n"
+        "# tiplint: disable=f64-on-tpu (host)\n"
+        "acc = np.zeros(4, dtype=np.float64)\n",
+    )
+    assert not unsuppressed(analyze_paths([root], select=["f64-on-tpu"]))
+
+
+def test_file_level_suppression(tmp_path):
+    root = str(tmp_path / "pkg")
+    _write(
+        root,
+        "ops/mod.py",
+        '"""m."""\n'
+        "# tiplint: disable-file=f64-on-tpu\n"
+        "import numpy as np\n"
+        "a = np.zeros(4, dtype=np.float64)\n"
+        "b = np.ones(4, dtype=np.float64)\n",
+    )
+    assert not unsuppressed(analyze_paths([root], select=["f64-on-tpu"]))
+
+
+def test_unrelated_suppression_does_not_apply(tmp_path):
+    root = str(tmp_path / "pkg")
+    _write(
+        root,
+        "ops/mod.py",
+        '"""m."""\n'
+        "import numpy as np\n"
+        "acc = np.zeros(4, dtype=np.float64)  # tiplint: disable=jit-purity\n",
+    )
+    assert unsuppressed(analyze_paths([root], select=["f64-on-tpu"]))
+
+
+def test_parse_error_is_reported(tmp_path):
+    root = str(tmp_path / "pkg")
+    _write(root, "broken.py", "def nope(:\n")
+    findings = analyze_paths([root])
+    assert any(f.rule == "parse-error" for f in findings)
+
+
+def test_reporters_cover_suppressed_and_active(tmp_path):
+    root = str(tmp_path / "pkg")
+    _write(
+        root,
+        "ops/mod.py",
+        '"""m."""\n'
+        "import numpy as np\n"
+        "a = np.zeros(2, dtype=np.float64)\n"
+        "b = np.ones(2, dtype=np.float64)  # tiplint: disable=f64-on-tpu (host)\n",
+    )
+    findings = analyze_paths([root], select=["f64-on-tpu"])
+    text = text_report(findings)
+    assert "1 finding(s), 1 suppressed" in text
+    doc = json.loads(json_report(findings))
+    assert doc["summary"] == {"total": 2, "unsuppressed": 1, "suppressed": 1}
+    assert {f["rule"] for f in doc["findings"]} == {"f64-on-tpu"}
+
+
+# --- CLI ---------------------------------------------------------------------
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    root = str(tmp_path / "pkg")
+    _write(root, "ops/bad.py", '"""m."""\nimport numpy as np\na = np.float64(1)\n')
+    assert main([root, "--select", "f64-on-tpu"]) == 1
+    assert main([root, "--select", "docstring-coverage"]) == 0
+    assert main([str(tmp_path / "missing"), ]) == 2
+    assert main([root, "--select", "no-such-rule"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_list_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in FIXTURES:
+        assert rule in out
+
+
+def test_cli_json_document(tmp_path, capsys):
+    root = str(tmp_path / "pkg")
+    _write(root, "ops/bad.py", '"""m."""\nimport numpy as np\na = np.float64(1)\n')
+    assert main([root, "--format", "json", "--select", "f64-on-tpu"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["summary"]["unsuppressed"] == 1
+
+
+def test_module_entrypoint_is_wired():
+    proc = subprocess.run(
+        [sys.executable, "-m", "simple_tip_tpu.analysis", "--list-rules"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "jit-purity" in proc.stdout
+
+
+# --- the tier-1 gate ---------------------------------------------------------
+
+
+def test_package_is_lint_clean():
+    """The acceptance gate: zero unsuppressed findings over the package."""
+    findings = unsuppressed(analyze_paths([PACKAGE]))
+    assert not findings, "tiplint findings:\n" + "\n".join(
+        f.format() for f in findings
+    )
