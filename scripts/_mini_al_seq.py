@@ -11,6 +11,7 @@ from scripts.mini_env import bootstrap  # noqa: E402
 
 
 def main():
+    """Run the mini sequential active-learning baseline and print JSON."""
     bootstrap()
     from simple_tip_tpu.casestudies.mini import provide
 

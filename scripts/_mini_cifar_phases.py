@@ -15,6 +15,7 @@ from scripts.mini_env import bootstrap, class_coverage_preflight  # noqa: E402
 
 
 def main():
+    """Run the mini CIFAR phase timings and print one JSON record."""
     bootstrap()
     from simple_tip_tpu.casestudies.mini import provide
 

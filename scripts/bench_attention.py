@@ -42,6 +42,7 @@ sys.path.insert(0, REPO)
 
 # exact-attention forward FLOPs: QK^T (2*T*T*D) + PV (2*T*T*D) per head.
 def attn_fwd_flops(b, h, t, d):
+    """Analytic forward FLOPs of one attention call."""
     return 4.0 * b * h * t * t * d
 
 
@@ -64,6 +65,7 @@ def _persist(record, out_path):
 
 
 def main():
+    """Benchmark the attention cores and print per-config records."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--require-device", action="store_true",
                     help="abort unless a non-cpu backend answers the probe")

@@ -49,12 +49,14 @@ def make_profiles(samples: int, sections: int, density: float, seed: int = 7):
 
 
 def time_once(fn, *args):
+    """One timed CAM-prioritization run (seconds)."""
     t0 = time.perf_counter()
     out = fn(*args)
     return np.asarray(out), time.perf_counter() - t0
 
 
 def main() -> int:
+    """Benchmark CAM backends across profile sizes and print JSON."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--samples", type=int, default=20_000)
     ap.add_argument("--sections", type=int, default=100_000)

@@ -69,6 +69,7 @@ def _timed(fn, *args, repeats=1, **kwargs):
 
 
 def main() -> int:
+    """Benchmark device kernels against the reference numpy code."""
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--skip-reference",

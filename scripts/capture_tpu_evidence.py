@@ -145,6 +145,7 @@ def _cli_phase(
 
 
 def main() -> int:
+    """Probe the TPU tunnel and persist benchmark evidence when healthy."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--runs", type=int, default=10)
     ap.add_argument("--case-study", default="mnist")

@@ -28,6 +28,7 @@ sys.path.insert(0, REPO)
 
 
 def run_all_evals(case_studies: Sequence[str]) -> None:
+    """Run every registered evaluation and collect their records."""
     from simple_tip_tpu.plotters import (
         eval_active_correlation,
         eval_active_learning_table,
@@ -47,6 +48,7 @@ def run_all_evals(case_studies: Sequence[str]) -> None:
 def nominal_fault_rates(
     assets: str, case_studies: Sequence[str], runs: int
 ) -> Dict[str, dict]:
+    """Per-case-study nominal misclassification rates of the run."""
     import numpy as np
 
     out: Dict[str, dict] = {}
@@ -104,6 +106,7 @@ def export_results(
 
 
 def hardness_env_label() -> str:
+    """Human-readable synthetic-hardness label for result provenance."""
     val = os.environ.get("TIP_SYNTH_HARDNESS")
     if val:
         return val
@@ -113,6 +116,7 @@ def hardness_env_label() -> str:
 
 
 def study_provenance(study_json: Optional[str]) -> dict:
+    """Provenance block (env knobs, backend, hardness) for exports."""
     if not study_json:
         return {}
     try:

@@ -35,6 +35,7 @@ ALL_PHASES = ("training", "test_prio", "active_learning", "at_collection", "eval
 
 
 def main() -> int:
+    """Run the full prioritization + active-learning study."""
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
         "--case-studies",

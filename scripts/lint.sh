@@ -1,11 +1,17 @@
 #!/usr/bin/env bash
-# Tier-0 static gate: bytecode-compile the package, then run the tiplint
-# analyzer (simple_tip_tpu/analysis) in text mode. Exits non-zero on any
-# syntax error or unsuppressed finding. Needs NO third-party packages —
-# the analyzer is stdlib-ast only — so it runs before the environment has
-# jax installed (CI lint job, pre-commit).
+# Tier-0 static gate: bytecode-compile the package plus the scripts/ and
+# tests/ trees, then run the tiplint analyzer (simple_tip_tpu/analysis)
+# over all three in one whole-program pass (the project-graph rules need
+# every module that imports the package). Exits non-zero on any syntax
+# error or unsuppressed finding. Needs NO third-party packages — the
+# analyzer is stdlib-ast only — so it runs before the environment has jax
+# installed (CI lint job, pre-commit).
+#
+# TIPLINT_FORMAT=github switches to GitHub workflow-command output so CI
+# findings annotate the PR diff inline (used by .github/workflows/lint.yml).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-python -m compileall -q simple_tip_tpu
-python -m simple_tip_tpu.analysis simple_tip_tpu --format text
+python -m compileall -q simple_tip_tpu scripts tests
+python -m simple_tip_tpu.analysis simple_tip_tpu scripts tests \
+  --format "${TIPLINT_FORMAT:-text}"

@@ -30,6 +30,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:
+    """Measure host-phase wall-clock split and print one JSON record."""
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--out",

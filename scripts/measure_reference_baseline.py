@@ -91,6 +91,7 @@ def quantify(probs: np.ndarray):
 
 
 def main():
+    """Refresh the measured reference-baseline proxy JSON."""
     forward = build_forward()
     rng = np.random.default_rng(1)
     x = rng.normal(size=(BATCH, 28, 28, 1)).astype(np.float32)

@@ -59,6 +59,7 @@ def _case_study_specs():
 
 
 def main():
+    """Measure batch/ensemble scaling curves and print JSON records."""
     parser = argparse.ArgumentParser()
     parser.add_argument("--groups", default="1,4,8")
     parser.add_argument("--chips", type=int, default=16, help="v4-32 = 16 chips")

@@ -33,6 +33,7 @@ CASE_STUDIES = ("mini-mnist", "mini-cifar10")
 
 
 def main() -> int:
+    """Run the reduced-size study used for smoke validation."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--runs", type=int, default=10)
     ap.add_argument(

@@ -57,6 +57,7 @@ def _slope_time(fn, fetch, k_hi=9, rounds=3):
 
 
 def main():
+    """Profile the bench hot path and write the trace artifacts."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(REPO, "MFU_BREAKDOWN.json"))
     ap.add_argument("--batch", type=int, default=32768)

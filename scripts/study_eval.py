@@ -35,6 +35,7 @@ from scripts.eval_export import (  # noqa: E402
 
 
 def main() -> int:
+    """Evaluate persisted study artifacts into summary tables."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--name", required=True, help="results/<name>/ output dir")
     ap.add_argument("--case-studies", default="mnist")

@@ -41,6 +41,7 @@ def _fetch_time(fn, *args, reps=5):
 
 
 def main():
+    """Validate the Pallas kernels against their oracles on this host."""
     from simple_tip_tpu.utils.device_watchdog import ensure_responsive_backend
 
     platform = ensure_responsive_backend(timeout_s=90)

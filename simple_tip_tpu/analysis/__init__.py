@@ -5,16 +5,21 @@ the defect classes that sink TPU systems statically: impure jitted functions,
 reused PRNG keys, implicit host↔device syncs in hot paths, f64 dtypes that
 silently downcast on TPU, undonated multi-GB ensemble buffers, drift in the
 filesystem artifact contract between the engine (writers) and the plotters
-(readers), and docstring-coverage regressions.
+(readers), and docstring-coverage regressions. A whole-program layer
+(``analysis.graph``: imports, call graph, jit/shard_map boundaries, mesh and
+PartitionSpec index) backs the cross-module rules: sharding-spec-mismatch,
+shape-polymorphism and transitive-jit-purity.
 
 Usage::
 
-    python -m simple_tip_tpu.analysis [paths...] [--format text|json]
+    python -m simple_tip_tpu.analysis [paths...] [--format text|json|github]
     python -m simple_tip_tpu.analysis --list-rules
 
-Suppress an intentional finding inline with a justification comment::
+Suppress an intentional finding inline with a justification comment
+(a suppression that stops matching anything is itself reported as
+``unused-suppression``, so the example below names no real rule)::
 
-    x = np.asarray(batch, dtype=np.float64)  # tiplint: disable=f64-on-tpu
+    x = np.asarray(batch, dtype=np.float64)  # tiplint: disable=<rule>
 
 See README.md section "Static analysis (tiplint)" for the rule catalogue.
 """

@@ -26,7 +26,9 @@ def build_parser() -> argparse.ArgumentParser:
         description=(
             "tiplint: JAX/TPU-aware static analysis for simple_tip_tpu "
             "(jit purity, PRNG hygiene, host syncs, f64-on-TPU, buffer "
-            "donation, artifact contract, docstring coverage)."
+            "donation, artifact contract, docstring coverage, and the "
+            "project-graph rules: sharding-spec-mismatch, "
+            "shape-polymorphism, transitive-jit-purity)."
         ),
     )
     parser.add_argument(

@@ -15,6 +15,9 @@ Vocabulary:
   ``# tiplint: disable=<rule>[,<rule>...]``, or the file carries a
   file-level ``# tiplint: disable-file=<rule>`` anywhere. Suppressions are
   reported (so silent rot is visible) but do not fail the run.
+- A suppression that matches NO finding during a full (unselected) run is
+  itself reported as a synthetic ``unused-suppression`` finding, so stale
+  justification comments surface instead of rotting.
 """
 
 import ast
@@ -44,6 +47,11 @@ class Finding:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
 
 
+#: A suppression entry key: ``(lineno, rule)`` for a line disable, or
+#: ``("file", rule)`` for a file-wide disable. ``rule`` may be ``"all"``.
+SuppressionKey = Tuple[object, str]
+
+
 @dataclass
 class ModuleInfo:
     """One parsed source module plus its suppression table."""
@@ -52,10 +60,12 @@ class ModuleInfo:
     relpath: str  # path relative to the analysis root, always '/'-separated
     source: str
     tree: ast.Module
+    root: str = ""  # the analysis root this module was found under
     lines: List[str] = field(default_factory=list)
     # line number -> set of rule names disabled on that line ('all' wildcard)
     line_disables: Dict[int, Set[str]] = field(default_factory=dict)
-    file_disables: Set[str] = field(default_factory=set)
+    # rule name -> line number of the first file-wide disable declaring it
+    file_disables: Dict[str, int] = field(default_factory=dict)
 
     @classmethod
     def parse(cls, path: str, root: str) -> "ModuleInfo":
@@ -64,33 +74,47 @@ class ModuleInfo:
             source = f.read()
         tree = ast.parse(source, filename=path)
         rel = os.path.relpath(path, root).replace(os.sep, "/")
-        info = cls(path=path, relpath=rel, source=source, tree=tree)
+        info = cls(path=path, relpath=rel, source=source, tree=tree, root=root)
         info.lines = source.splitlines()
         for lineno, text in enumerate(info.lines, start=1):
             m = _DISABLE_FILE_RE.search(text)
             if m:
-                info.file_disables.update(_split_rules(m.group(1)))
+                for name in _split_rules(m.group(1)):
+                    info.file_disables.setdefault(name, lineno)
                 continue
             m = _DISABLE_RE.search(text)
             if m:
                 info.line_disables[lineno] = _split_rules(m.group(1))
         return info
 
-    def is_suppressed(self, rule: str, line: int) -> bool:
-        """True if ``rule`` is disabled at ``line`` (inline, previous
-        comment-only line, or file-wide)."""
-        if {"all", rule} & self.file_disables:
-            return True
+    def suppression_match(self, rule: str, line: int) -> Optional[SuppressionKey]:
+        """The suppression entry that disables ``rule`` at ``line`` (inline,
+        previous comment-only line, or file-wide), or None.
+
+        The returned key identifies the *source comment* that matched, so the
+        driver can track which suppressions actually fire (unused-suppression
+        reporting). Specific rule names win over the ``all`` wildcard."""
         here = self.line_disables.get(line, set())
-        if {"all", rule} & here:
-            return True
+        for name in (rule, "all"):
+            if name in here:
+                return (line, name)
         # A standalone suppression comment may sit on its own line directly
         # above the flagged statement (useful for long expressions).
         prev = line - 1
         if 1 <= prev <= len(self.lines) and _COMMENT_ONLY_RE.match(self.lines[prev - 1]):
-            if {"all", rule} & self.line_disables.get(prev, set()):
-                return True
-        return False
+            prevset = self.line_disables.get(prev, set())
+            for name in (rule, "all"):
+                if name in prevset:
+                    return (prev, name)
+        for name in (rule, "all"):
+            if name in self.file_disables:
+                return ("file", name)
+        return None
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        """True if ``rule`` is disabled at ``line`` (inline, previous
+        comment-only line, or file-wide)."""
+        return self.suppression_match(rule, line) is not None
 
 
 def _split_rules(spec: str) -> Set[str]:
@@ -103,8 +127,12 @@ class Rule:
     Subclasses set ``name``/``description`` and override ``check_module``
     (called once per file) and/or ``check_package`` (called once per run
     with every parsed module — for cross-file contracts). Both yield
-    ``(relpath, line, message)`` triples; the driver owns Finding assembly
-    and suppression bookkeeping.
+    ``(path, line, message)`` triples; the driver owns Finding assembly and
+    suppression bookkeeping. ``check_module`` findings are attributed to the
+    module being checked (the yielded path is ignored); ``check_package``
+    rules must yield ``module.path`` (the absolute path) so attribution
+    stays unambiguous when two analysis roots contain the same relative
+    path — bare relpaths are accepted for compatibility when unique.
     """
 
     name: str = ""
@@ -171,6 +199,12 @@ def analyze_paths(
 
     Returns all findings, suppressed ones included (marked); callers decide
     what fails the run (the CLI exits non-zero on any unsuppressed finding).
+
+    When no ``select`` restriction is given, suppression comments that
+    disabled nothing during the run are themselves reported as synthetic
+    ``unused-suppression`` findings (like ``parse-error``, not a registered
+    rule), so stale suppressions can't rot silently after the code they
+    justified is refactored away.
     """
     rules = all_rules()
     if select:
@@ -181,7 +215,13 @@ def analyze_paths(
 
     modules: List[ModuleInfo] = []
     findings: List[Finding] = []
-    by_rel: Dict[str, ModuleInfo] = {}
+    # Modules are keyed by ABSOLUTE path (collision-free); the relpath table
+    # is a convenience lookup for package rules, with entries that two roots
+    # both claim (e.g. `simple_tip_tpu/__init__.py` and `tests/__init__.py`
+    # when both directories are analyzed) poisoned to None so suppression
+    # lookup can never consult the wrong module.
+    by_key: Dict[str, ModuleInfo] = {}
+    by_rel: Dict[str, Optional[ModuleInfo]] = {}
     for path, root in iter_python_files(paths):
         try:
             info = ModuleInfo.parse(path, root)
@@ -196,26 +236,101 @@ def analyze_paths(
             )
             continue
         modules.append(info)
-        by_rel[info.relpath] = info
+        by_key[info.path] = info
+        if info.relpath in by_rel and by_rel[info.relpath] is not info:
+            by_rel[info.relpath] = None
+        else:
+            by_rel[info.relpath] = info
+
+    # id(module) -> suppression keys that matched at least one finding
+    used: Dict[int, Set[SuppressionKey]] = {}
+
+    def display_path(module: ModuleInfo) -> str:
+        # Prefix colliding relpaths with their root's basename so two files
+        # from different roots never render identically in a report.
+        if by_rel.get(module.relpath) is module:
+            return module.relpath
+        return f"{os.path.basename(module.root)}/{module.relpath}"
+
+    def emit(rule_name: str, module: Optional[ModuleInfo],
+             path_hint: Optional[str], line: int, msg: str) -> None:
+        suppressed = False
+        if module is not None:
+            match = module.suppression_match(rule_name, line)
+            if match is not None:
+                suppressed = True
+                used.setdefault(id(module), set()).add(match)
+            path = display_path(module)
+        else:
+            path = path_hint or "<unknown>"
+        findings.append(
+            Finding(rule=rule_name, path=path, line=line, message=msg,
+                    suppressed=suppressed)
+        )
 
     for rule in rules.values():
-        raw: List[Tuple[str, int, str]] = []
         for module in modules:
-            raw.extend(
-                (module.relpath, line, msg)
-                for _rel, line, msg in rule.check_module(module)
-            )
-        raw.extend(rule.check_package(modules))
-        for rel, line, msg in raw:
-            module = by_rel.get(rel)
-            suppressed = module.is_suppressed(rule.name, line) if module else False
-            findings.append(
-                Finding(rule=rule.name, path=rel, line=line, message=msg,
-                        suppressed=suppressed)
-            )
+            for _rel, line, msg in rule.check_module(module):
+                emit(rule.name, module, None, line, msg)
+        for key, line, msg in rule.check_package(modules):
+            # Package rules yield the module's absolute path (module.path);
+            # bare relpaths are accepted for compatibility when unambiguous.
+            module = by_key.get(key)
+            if module is None:
+                module = by_rel.get(key)
+            emit(rule.name, module, key, line, msg)
+
+    if select is None:
+        _report_unused_suppressions(modules, rules, used, emit)
 
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings
+
+
+def _report_unused_suppressions(
+    modules: Sequence[ModuleInfo],
+    rules: Dict[str, Rule],
+    used: Dict[int, Set[SuppressionKey]],
+    emit,
+) -> None:
+    """Emit ``unused-suppression`` findings for disable comments that matched
+    nothing. Runs only on full (unselected) runs — with ``--select`` most
+    suppressions legitimately never fire.
+
+    Two passes per module: ordinary rule names first, then stale
+    ``unused-suppression`` disables — so a disable comment whose only job is
+    to suppress an unused-suppression finding on the next line is counted as
+    used before it is judged.
+    """
+    known = set(rules) | {"all", "parse-error", "unused-suppression"}
+
+    def message(name: str, scope: str) -> str:
+        if name not in known:
+            return (
+                f"suppression of unknown rule '{name}' ({scope}) matches "
+                "nothing; fix the rule name or delete the comment"
+            )
+        return (
+            f"suppression of '{name}' ({scope}) no longer matches any "
+            "finding; delete the stale comment"
+        )
+
+    for module in modules:
+        mused = used.setdefault(id(module), set())
+        entries: List[Tuple[int, str, SuppressionKey, str]] = []
+        for lineno, names in sorted(module.line_disables.items()):
+            for name in sorted(names):
+                entries.append((lineno, name, (lineno, name), "inline"))
+        for name, lineno in sorted(module.file_disables.items()):
+            entries.append((lineno, name, ("file", name), "file-wide"))
+        for deferred in (False, True):
+            for lineno, name, key, scope in entries:
+                if (name == "unused-suppression") is not deferred:
+                    continue
+                if key in mused:
+                    continue
+                emit("unused-suppression", module, None, lineno,
+                     message(name, scope))
 
 
 def unsuppressed(findings: Iterable[Finding]) -> List[Finding]:

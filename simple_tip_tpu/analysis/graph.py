@@ -1,0 +1,479 @@
+"""tiplint project graph: whole-program import/call/sharding index.
+
+The per-file rules (``rules/common.py``) are deliberately local — they see
+one module at a time. The defect classes that actually sink pjit/shard_map
+programs are inherently cross-module: a ``PartitionSpec`` naming an axis no
+mesh constructs, an impure helper reached *through* a call chain into a
+jitted function defined elsewhere, a concrete-shape assumption in a kernel
+traced from another file. This module builds the whole-program picture the
+graph-backed rules (``sharding_spec``, ``transitive_purity``) reason over:
+
+- **module naming**: every analyzed file gets a canonical dotted module name
+  (a root directory containing ``__init__.py`` contributes its basename as
+  the package prefix, so ``simple_tip_tpu/parallel/ensemble.py`` under the
+  package root is ``simple_tip_tpu.parallel.ensemble`` — exactly what its
+  absolute imports say);
+- **function index**: module- and class-level defs, addressable by dotted
+  name, so an import alias resolves to the function object it names;
+- **call graph**: for any function body, the resolvable intra-project call
+  edges (bare local names, imported names, ``mod.fn`` attribute chains and
+  ``functools.partial(f, ...)`` wrappers);
+- **trace boundaries**: every ``jit``/``pjit``/``vmap``/``shard_map``/
+  ``pallas_call`` call site together with the project function it traces
+  (resolved through partial wrappers and local bindings), which is how a
+  function with no local jit marker is discovered to be device code because
+  *another module* shard_maps it;
+- **sharding index**: every ``Mesh(...)``/``jax.make_mesh(...)`` site with
+  its axis-name tuple, and every ``PartitionSpec(...)`` literal with its
+  axis-name strings — string constants resolve through module-level
+  ``NAME = "axis"`` assignments and cross-module imports of them.
+
+Everything here is stdlib-``ast`` (the analyzer must run without jax
+installed) and intentionally syntactic: resolution is best-effort, and every
+consumer treats "unresolved" as "unknown", never as "safe" or "unsafe".
+"""
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo
+from simple_tip_tpu.analysis.rules.common import (
+    FunctionNode,
+    TRANSFORM_CALLEES,
+    callable_targets,
+    callee_name,
+    dotted,
+    function_body_nodes,
+    import_aliases,
+    jit_reachable_functions,
+    name_bindings,
+)
+
+#: Callees that construct a device mesh; the axis-name tuple is the second
+#: positional argument or the ``axis_names`` keyword.
+MESH_CALLEES = {
+    "jax.sharding.Mesh",
+    "jax.experimental.maps.Mesh",
+    "jax.interpreters.pxla.Mesh",
+    "jax.make_mesh",
+    "jax.sharding.make_mesh",
+}
+
+#: Callees that construct a PartitionSpec (positional args are axis names).
+PARTITION_SPEC_CALLEES = {
+    "jax.sharding.PartitionSpec",
+    "jax.experimental.pjit.PartitionSpec",
+    "jax.interpreters.pxla.PartitionSpec",
+    "jax.P",
+}
+
+
+@dataclass(eq=False)
+class FunctionInfo:
+    """One module- or class-level function definition in the project."""
+
+    module: ModuleInfo
+    qualname: str  # "fn" or "Class.fn"
+    node: FunctionNode
+    dotted: str  # "<module dotted name>.<qualname>"
+
+    @property
+    def line(self) -> int:
+        """Definition line of the function."""
+        return self.node.lineno
+
+
+@dataclass(eq=False)
+class MeshSite:
+    """One ``Mesh(...)`` construction and the axis names it declares."""
+
+    module: ModuleInfo
+    line: int
+    axes: Tuple[str, ...]  # the resolved axis-name strings
+    complete: bool  # False when some axis expression did not resolve
+
+
+@dataclass(eq=False)
+class SpecSite:
+    """One ``PartitionSpec(...)`` literal and its resolved axis names."""
+
+    module: ModuleInfo
+    line: int
+    axes: Tuple[str, ...]  # resolved string axes only (None entries dropped)
+
+
+@dataclass(eq=False)
+class Boundary:
+    """One trace boundary: a transform call and the function it traces."""
+
+    module: ModuleInfo
+    line: int
+    transform: str  # canonical dotted transform name (jax.shard_map, ...)
+    target: Optional["FunctionInfo"]  # None when the callee didn't resolve
+
+
+@dataclass(eq=False)
+class _ModuleIndex:
+    """Per-module resolution state the graph builds once."""
+
+    info: ModuleInfo
+    name: str  # dotted module name
+    aliases: Dict[str, str] = field(default_factory=dict)
+    bindings: Dict[str, List[ast.expr]] = field(default_factory=dict)
+    constants: Dict[str, str] = field(default_factory=dict)  # NAME -> "str"
+    defs: Dict[str, FunctionInfo] = field(default_factory=dict)  # by qualname
+    jit_local: Set[FunctionNode] = field(default_factory=set)
+
+
+def module_dotted_name(module: ModuleInfo, package_roots: Set[str]) -> str:
+    """Canonical dotted name of ``module`` (see the module docstring)."""
+    parts = module.relpath[:-3].split("/")  # strip ".py"
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    if module.root in package_roots:
+        parts = [os.path.basename(module.root)] + parts
+    return ".".join(parts)
+
+
+class ProjectGraph:
+    """Whole-program index over one ``analyze_paths`` module set.
+
+    Build once per run with :meth:`build` (package rules share a single
+    instance via :func:`project_graph`, keyed on the module list identity,
+    so the three graph-backed rules don't triplicate the work).
+    """
+
+    def __init__(self, modules: Sequence[ModuleInfo]):
+        package_roots = {
+            m.root for m in modules if m.relpath == "__init__.py"
+        }
+        self._by_module: Dict[int, _ModuleIndex] = {}
+        self._by_name: Dict[str, _ModuleIndex] = {}
+        self.functions: Dict[str, FunctionInfo] = {}  # by dotted name
+        self.meshes: List[MeshSite] = []
+        self.specs: List[SpecSite] = []
+        self.boundaries: List[Boundary] = []
+
+        for m in modules:
+            idx = _ModuleIndex(info=m, name=module_dotted_name(m, package_roots))
+            idx.aliases = import_aliases(m.tree)
+            self._augment_relative_imports(idx)
+            idx.bindings = name_bindings(m.tree)
+            idx.constants = _module_constants(m.tree)
+            self._by_module[id(m)] = idx
+            self._by_name[idx.name] = idx
+            for qualname, node in _iter_defs(m.tree):
+                fi = FunctionInfo(
+                    module=m,
+                    qualname=qualname,
+                    node=node,
+                    dotted=f"{idx.name}.{qualname}" if idx.name else qualname,
+                )
+                idx.defs.setdefault(qualname, fi)
+                self.functions.setdefault(fi.dotted, fi)
+
+        # Second pass: needs the full function index for target resolution.
+        for m in modules:
+            idx = self._by_module[id(m)]
+            idx.jit_local = jit_reachable_functions(m.tree, idx.aliases)
+            self._index_sharding(idx)
+            self._index_boundaries(idx)
+
+    # -- construction helpers -------------------------------------------------
+
+    @staticmethod
+    def _augment_relative_imports(idx: _ModuleIndex) -> None:
+        """Resolve ``from . import x`` / ``from ..pkg import y`` aliases
+        (skipped by ``import_aliases``) against the module's own package."""
+        pkg_parts = idx.name.split(".")[:-1] if idx.name else []
+        for node in ast.walk(idx.info.tree):
+            if not (isinstance(node, ast.ImportFrom) and node.level > 0):
+                continue
+            base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+            if node.level - 1 > len(pkg_parts):
+                continue  # escapes the analyzed tree
+            prefix = ".".join(base + ([node.module] if node.module else []))
+            for a in node.names:
+                if prefix:
+                    idx.aliases.setdefault(
+                        a.asname or a.name, f"{prefix}.{a.name}"
+                    )
+
+    def _index_sharding(self, idx: _ModuleIndex) -> None:
+        for node in ast.walk(idx.info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = callee_name(node, idx.aliases)
+            if name in MESH_CALLEES:
+                axes_node = None
+                if len(node.args) >= 2:
+                    axes_node = node.args[1]
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        axes_node = kw.value
+                axes, complete = self._resolve_axes(idx, axes_node)
+                self.meshes.append(
+                    MeshSite(
+                        module=idx.info,
+                        line=node.lineno,
+                        axes=tuple(axes),
+                        complete=complete,
+                    )
+                )
+            elif name in PARTITION_SPEC_CALLEES:
+                axes: List[str] = []
+                elements: List[ast.AST] = []
+                for arg in node.args:
+                    if isinstance(arg, (ast.Tuple, ast.List)):
+                        elements.extend(arg.elts)
+                    else:
+                        elements.append(arg)
+                for el in elements:
+                    s = self.resolve_string(idx.info, el)
+                    if s is not None:
+                        axes.append(s)
+                self.specs.append(
+                    SpecSite(module=idx.info, line=node.lineno, axes=tuple(axes))
+                )
+
+    def _resolve_axes(
+        self, idx: _ModuleIndex, axes_node: Optional[ast.AST]
+    ) -> Tuple[List[str], bool]:
+        if axes_node is None:
+            return [], False
+        elements: List[ast.AST]
+        if isinstance(axes_node, (ast.Tuple, ast.List)):
+            elements = list(axes_node.elts)
+        else:
+            elements = [axes_node]
+        axes: List[str] = []
+        complete = True
+        for el in elements:
+            s = self.resolve_string(idx.info, el)
+            if s is None:
+                complete = False
+            else:
+                axes.append(s)
+        return axes, complete
+
+    def _index_boundaries(self, idx: _ModuleIndex) -> None:
+        # Decorator boundaries: @jax.jit / @partial(jax.jit, ...) on a def.
+        for fi in idx.defs.values():
+            decorators = getattr(fi.node, "decorator_list", [])
+            for d in decorators:
+                transform = _decorator_transform(d, idx.aliases)
+                if transform is not None:
+                    self.boundaries.append(
+                        Boundary(
+                            module=idx.info, line=fi.node.lineno,
+                            transform=transform, target=fi,
+                        )
+                    )
+        # Call boundaries: jax.jit(f), jax.shard_map(partial(f, ...), ...).
+        for node in ast.walk(idx.info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = callee_name(node, idx.aliases)
+            if name not in TRANSFORM_CALLEES or not node.args:
+                continue
+            targets, _lambdas = callable_targets(
+                node.args[0], idx.aliases, idx.bindings
+            )
+            resolved = [
+                fi
+                for fi in (
+                    self.resolve_function(idx.info, t) for t in sorted(targets)
+                )
+                if fi is not None
+            ]
+            if resolved:
+                for fi in resolved:
+                    self.boundaries.append(
+                        Boundary(
+                            module=idx.info, line=node.lineno,
+                            transform=name, target=fi,
+                        )
+                    )
+            else:
+                self.boundaries.append(
+                    Boundary(
+                        module=idx.info, line=node.lineno,
+                        transform=name, target=None,
+                    )
+                )
+
+    # -- queries --------------------------------------------------------------
+
+    def module_name(self, module: ModuleInfo) -> str:
+        """Dotted module name of an analyzed module."""
+        return self._by_module[id(module)].name
+
+    def jit_reachable(self, module: ModuleInfo) -> Set[FunctionNode]:
+        """The module's locally jit-reachable function nodes (cached)."""
+        return self._by_module[id(module)].jit_local
+
+    def resolve_string(
+        self, module: ModuleInfo, node: ast.AST, _depth: int = 0
+    ) -> Optional[str]:
+        """A string literal, or a Name/Attribute resolving (possibly through
+        imports) to a module-level ``NAME = "str"`` constant; else None."""
+        if isinstance(node, ast.Constant):
+            return node.value if isinstance(node.value, str) else None
+        if _depth > 4:
+            return None
+        idx = self._by_module[id(module)]
+        name = dotted(node, idx.aliases) if isinstance(
+            node, (ast.Name, ast.Attribute)
+        ) else None
+        if name is None:
+            return None
+        if "." not in name:
+            return idx.constants.get(name)
+        if name in self._by_name:
+            return None  # the name denotes a module, not a constant
+        owner, attr = name.rsplit(".", 1)
+        target = self._by_name.get(owner)
+        if target is not None:
+            return target.constants.get(attr)
+        return None
+
+    def resolve_function(
+        self, module: ModuleInfo, name: str
+    ) -> Optional[FunctionInfo]:
+        """The FunctionInfo a (possibly dotted, alias-resolved) name denotes
+        from ``module``'s point of view, or None."""
+        if not name:
+            return None
+        idx = self._by_module[id(module)]
+        if "." not in name:
+            return idx.defs.get(name)
+        # Fully-qualified: "pkg.mod.fn" or "pkg.mod.Class.fn".
+        fi = self.functions.get(name)
+        if fi is not None:
+            return fi
+        # "modalias.fn" where the alias maps to a module dotted name.
+        owner, attr = name.rsplit(".", 1)
+        target = self._by_name.get(owner)
+        if target is not None:
+            return target.defs.get(attr)
+        return None
+
+    def calls_from(
+        self, module: ModuleInfo, fn: FunctionNode
+    ) -> Iterator[Tuple[ast.Call, FunctionInfo]]:
+        """Resolvable project-internal call edges out of ``fn``'s body.
+
+        Covers direct calls (``helper(...)``, ``mod.helper(...)``) and
+        ``functools.partial(helper, ...)`` references — a partial built
+        inside traced code executes its target under the same trace.
+        """
+        idx = self._by_module[id(module)]
+        seen: Set[Tuple[int, int]] = set()
+        for node in function_body_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = callee_name(node, idx.aliases)
+            candidates: Set[str] = set()
+            if name is not None and name not in TRANSFORM_CALLEES:
+                candidates.add(name)
+            if name in ("functools.partial", "partial") and node.args:
+                sub, _ = callable_targets(node.args[0], idx.aliases, idx.bindings)
+                candidates = sub
+            for cand in sorted(candidates):
+                fi = self.resolve_function(module, cand)
+                if fi is None or fi.node is fn:
+                    continue
+                key = (node.lineno, id(fi))
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield node, fi
+
+    def traced_entries(self) -> Iterator[Tuple[FunctionInfo, Optional[Boundary]]]:
+        """Every project function known to execute as traced device code.
+
+        Yields ``(function, boundary)`` pairs: boundary is None for
+        functions locally jit-reachable in their own module, and the
+        cross-module trace site (e.g. the shard_map call in another file)
+        otherwise.
+        """
+        emitted: Set[int] = set()
+        for idx in self._by_module.values():
+            for fi in idx.defs.values():
+                if fi.node in idx.jit_local and id(fi) not in emitted:
+                    emitted.add(id(fi))
+                    yield fi, None
+        for b in self.boundaries:
+            if b.target is not None and id(b.target) not in emitted:
+                emitted.add(id(b.target))
+                yield b.target, b
+
+
+def _decorator_transform(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """The canonical transform name a decorator applies, or None.
+
+    ``@jax.jit`` -> ``jax.jit``; ``@partial(jax.jit, ...)`` and
+    ``@jax.jit(static_argnames=...)`` both -> ``jax.jit``.
+    """
+    name = dotted(node, aliases)
+    if name in TRANSFORM_CALLEES:
+        return name
+    if isinstance(node, ast.Call):
+        inner = callee_name(node, aliases)
+        if inner in TRANSFORM_CALLEES:
+            return inner
+        if inner in ("functools.partial", "partial") and node.args:
+            first = dotted(node.args[0], aliases)
+            if first in TRANSFORM_CALLEES:
+                return first
+    return None
+
+
+def _module_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "string"`` (and annotated) assignments."""
+    constants: Dict[str, str] = {}
+    for node in tree.body:
+        value = None
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, targets = node.value, [node.target]
+        if not (isinstance(value, ast.Constant) and isinstance(value.value, str)):
+            continue
+        for target in targets:
+            if isinstance(target, ast.Name):
+                constants.setdefault(target.id, value.value)
+    return constants
+
+
+def _iter_defs(tree: ast.Module) -> Iterator[Tuple[str, FunctionNode]]:
+    """(qualname, node) for module-level defs and class methods."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{sub.name}", sub
+
+
+#: (module list, graph) of the most recent build. Identity-compared (a
+#: strong reference, so the list's id can never be recycled underneath us).
+_LAST_GRAPH: Optional[Tuple[Sequence[ModuleInfo], ProjectGraph]] = None
+
+
+def project_graph(modules: Sequence[ModuleInfo]) -> ProjectGraph:
+    """The (per-run cached) ProjectGraph for a module set.
+
+    ``analyze_paths`` hands every package rule the same list object, so
+    caching on its identity means the graph is built once per run no matter
+    how many graph-backed rules are registered. Only the latest module set
+    is kept — an analyzer run is single-threaded and sequential.
+    """
+    global _LAST_GRAPH
+    if _LAST_GRAPH is None or _LAST_GRAPH[0] is not modules:
+        _LAST_GRAPH = (modules, ProjectGraph(modules))
+    return _LAST_GRAPH[1]

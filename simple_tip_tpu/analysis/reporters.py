@@ -1,6 +1,6 @@
-"""tiplint output formats: human text and machine JSON.
+"""tiplint output formats: human text, machine JSON and GitHub annotations.
 
-Both reporters consume the full finding list (suppressed findings included)
+All reporters consume the full finding list (suppressed findings included)
 so suppression debt stays visible in every report.
 """
 
@@ -46,7 +46,39 @@ def json_report(findings: Iterable[Finding]) -> str:
     return json.dumps(doc, indent=2, sort_keys=True)
 
 
-REPORTERS = {"text": text_report, "json": json_report}
+def _gh_escape(value: str, *, property: bool = False) -> str:
+    """GitHub workflow-command escaping (the documented %/CR/LF set; property
+    values additionally escape ``:`` and ``,``)."""
+    value = value.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    if property:
+        value = value.replace(":", "%3A").replace(",", "%2C")
+    return value
+
+
+def github_report(findings: Iterable[Finding]) -> str:
+    """GitHub Actions workflow commands: one ``::error`` annotation per
+    unsuppressed finding (renders inline on the PR diff), ``::notice`` for
+    suppressed ones (debt stays visible without failing review), plus the
+    same trailing summary line as the text reporter."""
+    findings = list(findings)
+    active = unsuppressed(findings)
+    lines = []
+    for f in findings:
+        level = "error" if not f.suppressed else "notice"
+        message = f.message + (" (suppressed)" if f.suppressed else "")
+        lines.append(
+            f"::{level} file={_gh_escape(f.path, property=True)},"
+            f"line={f.line},title=tiplint {_gh_escape(f.rule, property=True)}"
+            f"::{_gh_escape(message)}"
+        )
+    lines.append(
+        f"tiplint: {len(active)} finding(s), "
+        f"{len(findings) - len(active)} suppressed"
+    )
+    return "\n".join(lines)
+
+
+REPORTERS = {"text": text_report, "json": json_report, "github": github_report}
 
 
 def render(findings: List[Finding], fmt: str) -> str:

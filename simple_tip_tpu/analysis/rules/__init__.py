@@ -13,4 +13,7 @@ from simple_tip_tpu.analysis.rules import (  # noqa: F401
     host_sync,
     jit_purity,
     prng_hygiene,
+    shape_poly,
+    sharding_spec,
+    transitive_purity,
 )

@@ -51,6 +51,7 @@ _SUFFIX_RE = re.compile(r"(\.[A-Za-z0-9]+)$")
 class _BusUse:
     bus: str
     relpath: str
+    path: str  # absolute path, the driver's attribution key
     line: int
 
 
@@ -60,6 +61,7 @@ class _Template:
     fields: int
     suffix: str
     relpath: str
+    path: str  # absolute path, the driver's attribution key
     line: int
     text: str
 
@@ -165,7 +167,10 @@ def _collect(modules: Sequence[ModuleInfo]):
             elif isinstance(node, ast.BinOp):
                 bus = _bus_name_from_binop(node, aliases)
             if bus is not None:
-                use = _BusUse(bus=bus, relpath=module.relpath, line=node.lineno)
+                use = _BusUse(
+                    bus=bus, relpath=module.relpath, path=module.path,
+                    line=node.lineno,
+                )
                 uses.append(use)
                 scope_buses.setdefault(
                     _enclosing_function(node, parents), []
@@ -185,6 +190,7 @@ def _collect(modules: Sequence[ModuleInfo]):
                             fields=fields,
                             suffix=suffix,
                             relpath=module.relpath,
+                            path=module.path,
                             line=line,
                             text=text,
                         )
@@ -214,6 +220,7 @@ class ArtifactContractRule(Rule):
     def check_package(
         self, modules: Sequence[ModuleInfo]
     ) -> Iterator[Tuple[str, int, str]]:
+        """Cross-check writer/reader bus uses and filename templates."""
         uses, templates = _collect(modules)
         if not uses:
             return
@@ -228,7 +235,7 @@ class ArtifactContractRule(Rule):
         for bus, use in sorted(writer_buses.items()):
             if bus in EXEMPT_BUSES or bus in reader_buses:
                 continue
-            yield use.relpath, use.line, (
+            yield use.path, use.line, (
                 f"engine writes artifact bus `{bus}` but no plotters/utils "
                 "module reads it: orphaned artifacts (add a reader or exempt "
                 "the bus)"
@@ -236,7 +243,7 @@ class ArtifactContractRule(Rule):
         for bus, use in sorted(reader_buses.items()):
             if bus in EXEMPT_BUSES or bus in writer_buses:
                 continue
-            yield use.relpath, use.line, (
+            yield use.path, use.line, (
                 f"`{bus}` is read by aggregation but no engine module writes "
                 "it: the reader can only ever see an empty bus"
             )
@@ -262,7 +269,7 @@ class ArtifactContractRule(Rule):
                     options = ", ".join(
                         sorted({f"{wt.text} ({wt.relpath})" for wt in writers})
                     )
-                    yield rt.relpath, rt.line, (
+                    yield rt.path, rt.line, (
                         f"reader template `{rt.text}` on bus `{bus}` matches "
                         f"no writer template (writers emit: {options}): "
                         "filename contract drift"
@@ -279,7 +286,7 @@ class ArtifactContractRule(Rule):
                     options = ", ".join(
                         sorted({f"{rt.text} ({rt.relpath})" for rt in readers})
                     )
-                    yield wt.relpath, wt.line, (
+                    yield wt.path, wt.line, (
                         f"writer template `{wt.text}` on bus `{bus}` is "
                         f"parseable by no reader template (readers expect: "
                         f"{options}): filename contract drift"

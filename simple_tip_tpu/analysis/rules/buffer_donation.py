@@ -52,6 +52,7 @@ class BufferDonationRule(Rule):
     )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Flag state-threading jits that do not donate their state args."""
         aliases = import_aliases(module.tree)
 
         # Form 1: decorators on defs.

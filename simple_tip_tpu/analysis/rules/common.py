@@ -5,15 +5,21 @@ rendering for call/attribute chains, and the *jit-reachability* analysis that
 decides which function bodies are traced device code.
 
 Jit-reachability is an intentionally local, syntactic over/under-approximation
-(no call-graph, no cross-module dataflow). A function is jit-reachable when:
+(no cross-module dataflow — the project graph in ``analysis.graph`` layers
+that on top). A function is jit-reachable when:
 
 1. it is decorated with a JAX transform (``@jax.jit``, ``@jax.vmap``,
    ``@functools.partial(jax.jit, ...)``, ...);
-2. it (or a lambda) is passed by name into a transform call in the same
-   module (``jax.jit(f)``, ``jax.vmap(f)``, ``jax.lax.scan(step, ...)``);
+2. it (or a lambda) is passed into a transform call in the same module —
+   by name (``jax.jit(f)``, ``jax.lax.scan(step, ...)``), through
+   ``functools.partial(f, ...)``, or via a local ``g = partial(f, ...)``
+   binding later passed in (``jax.shard_map(g, ...)``); shard_map and
+   ``pallas_call`` count as transforms — their callees are traced device
+   code;
 3. its body uses ``jax.lax`` control flow (``scan``/``while_loop``/
-   ``fori_loop``/``cond``/``map``) — functions structured around lax control
-   flow are device code even when the jit wrapper is applied by a factory in
+   ``fori_loop``/``cond``/``map``) or a cross-device collective
+   (``ppermute``/``all_to_all``/``psum``/...) — such functions are device
+   code even when the jit/shard_map wrapper is applied by a factory in
    another function (the ``make_epoch_core`` pattern in models/train.py);
 4. it is nested inside a jit-reachable function.
 """
@@ -34,6 +40,9 @@ TRANSFORM_CALLEES = {
     "jax.checkpoint",
     "jax.remat",
     "jax.experimental.pjit.pjit",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.experimental.pallas.pallas_call",
     "jax.lax.scan",
     "jax.lax.while_loop",
     "jax.lax.fori_loop",
@@ -51,6 +60,26 @@ LAX_CONTROL_FLOW = {
     "jax.lax.cond",
     "jax.lax.map",
     "jax.lax.switch",
+}
+
+#: Cross-device collectives: they require a bound mesh axis name, so a
+#: function calling one can ONLY execute as traced device code under
+#: shard_map/pmap — the same enclosing-function marker as lax control flow
+#: (heuristic 3), covering collectives-only bodies like ulysses' all-to-all
+#: re-shard that carry no lax control flow of their own.
+LAX_COLLECTIVES = {
+    "jax.lax.ppermute",
+    "jax.lax.pshuffle",
+    "jax.lax.all_to_all",
+    "jax.lax.all_gather",
+    "jax.lax.psum",
+    "jax.lax.psum_scatter",
+    "jax.lax.pmean",
+    "jax.lax.pmax",
+    "jax.lax.pmin",
+    "jax.lax.axis_index",
+    "jax.lax.pvary",
+    "jax.lax.pcast",
 }
 
 
@@ -121,6 +150,64 @@ def _transform_target(node: ast.AST, aliases: Dict[str, str]) -> bool:
     return False
 
 
+def name_bindings(tree: ast.Module) -> Dict[str, List[ast.expr]]:
+    """name -> every expression assigned to it via a simple ``name = expr``.
+
+    All assignments to a name are kept (a name bound in both branches of an
+    ``if`` — the ``shard_fn = partial(...)`` pattern in models/transformer.py
+    — must resolve to every candidate, not just the last)."""
+    bindings: Dict[str, List[ast.expr]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                bindings.setdefault(target.id, []).append(node.value)
+    return bindings
+
+
+def callable_targets(
+    expr: ast.AST,
+    aliases: Dict[str, str],
+    bindings: Dict[str, List[ast.expr]],
+    _depth: int = 0,
+) -> Tuple[Set[str], Set[ast.Lambda]]:
+    """(dotted names, lambda nodes) an expression may denote as a callable.
+
+    Unwraps ``functools.partial(f, ...)`` to ``f``, follows simple local
+    ``name = <callable expr>`` bindings one level at a time (bounded depth),
+    and resolves names through the module's import aliases — so
+    ``shard_fn = partial(ulysses_attention, ...)`` followed by
+    ``jax.shard_map(shard_fn, ...)`` reports ``ulysses_attention``'s dotted
+    name as a traced target."""
+    names: Set[str] = set()
+    lambdas: Set[ast.Lambda] = set()
+    if _depth > 4:
+        return names, lambdas
+    if isinstance(expr, ast.Lambda):
+        lambdas.add(expr)
+    elif isinstance(expr, ast.Name):
+        names.add(aliases.get(expr.id, expr.id))
+        for bound in bindings.get(expr.id, []):
+            sub_names, sub_lambdas = callable_targets(
+                bound, aliases, bindings, _depth + 1
+            )
+            names |= sub_names
+            lambdas |= sub_lambdas
+    elif isinstance(expr, ast.Attribute):
+        name = dotted(expr, aliases)
+        if name:
+            names.add(name)
+    elif isinstance(expr, ast.Call):
+        callee = callee_name(expr, aliases)
+        if callee in ("functools.partial", "partial") and expr.args:
+            sub_names, sub_lambdas = callable_targets(
+                expr.args[0], aliases, bindings, _depth + 1
+            )
+            names |= sub_names
+            lambdas |= sub_lambdas
+    return names, lambdas
+
+
 def parent_map(tree: ast.Module) -> Dict[ast.AST, ast.AST]:
     """child node -> parent node for the whole tree."""
     parents: Dict[ast.AST, ast.AST] = {}
@@ -145,6 +232,7 @@ def jit_reachable_functions(
             all_funcs.append(node)
 
     reachable: Set[FunctionNode] = set()
+    bindings = name_bindings(tree)
 
     # (1) decorated with a transform
     for fn in all_funcs:
@@ -152,26 +240,30 @@ def jit_reachable_functions(
             if any(_transform_target(d, aliases) for d in fn.decorator_list):
                 reachable.add(fn)
 
-    # (2) passed (by name or inline) into a transform call
+    # (2) passed into a transform call — by name, inline lambda, through a
+    # functools.partial wrapper, or via a local `name = partial(f, ...)`
+    # binding (the shard_map dispatch pattern in models/transformer.py)
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
         if not _transform_target(node.func, aliases):
             continue
         for arg in node.args:
-            if isinstance(arg, ast.Lambda):
-                reachable.add(arg)
-            elif isinstance(arg, ast.Name):
-                for fn in defs_by_name.get(arg.id, []):
+            names, lambdas = callable_targets(arg, aliases, bindings)
+            reachable.update(lambdas)
+            for name in names:
+                for fn in defs_by_name.get(name.rsplit(".", 1)[-1], []):
                     reachable.add(fn)
 
-    # (3) body uses lax control flow
+    # (3) body uses lax control flow or a cross-device collective (the
+    # latter requires a bound mesh axis, i.e. shard_map/pmap tracing)
     for fn in all_funcs:
         body = fn.body if isinstance(fn.body, list) else [fn.body]
         for stmt in body:
             for node in ast.walk(stmt):
                 if isinstance(node, ast.Call):
-                    if callee_name(node, aliases) in LAX_CONTROL_FLOW:
+                    name = callee_name(node, aliases)
+                    if name in LAX_CONTROL_FLOW or name in LAX_COLLECTIVES:
                         reachable.add(fn)
 
     # (4) nested defs inside reachable functions

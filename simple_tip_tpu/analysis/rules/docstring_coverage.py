@@ -13,7 +13,10 @@ Findings:
 - a package-level finding when the public class/function docstring rate
   drops below ``REQUIRED_RATE`` (0.9, same threshold as the reference's
   gate). Public defs are module- and class-level only — nested closures are
-  implementation detail, not API surface.
+  implementation detail, not API surface. ``test_*`` functions inside test
+  modules (``test_*.py``/``conftest.py``) are exempt from the rate: a test's
+  name IS its spec, matching docstr-coverage's own test-exclusion default —
+  the module docstring requirement still applies to test modules.
 """
 
 import ast
@@ -38,6 +41,11 @@ def public_defs(tree: ast.Module) -> Iterator[ast.AST]:
     yield from scoped(tree.body)
 
 
+def _is_test_module(relpath: str) -> bool:
+    base = relpath.rsplit("/", 1)[-1]
+    return base.startswith("test_") or base == "conftest.py"
+
+
 @register
 class DocstringCoverageRule(Rule):
     """Module docstrings everywhere; >= 90% documented public defs."""
@@ -50,6 +58,7 @@ class DocstringCoverageRule(Rule):
     )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Require a module docstring (empty namespace inits exempt)."""
         tree = module.tree
         if module.relpath.endswith("__init__.py") and not tree.body:
             return  # empty namespace init
@@ -59,26 +68,30 @@ class DocstringCoverageRule(Rule):
     def check_package(
         self, modules: Sequence[ModuleInfo]
     ) -> Iterator[Tuple[str, int, str]]:
+        """Enforce the package-wide public docstring rate."""
         total, documented = 0, 0
-        undocumented: List[Tuple[str, int, str]] = []
+        undocumented: List[Tuple[str, str, int, str]] = []
         for module in modules:
+            is_test = _is_test_module(module.relpath)
             for node in public_defs(module.tree):
+                if is_test and node.name.startswith("test_"):
+                    continue  # the test name is the spec
                 total += 1
                 if ast.get_docstring(node) is not None:
                     documented += 1
                 else:
                     undocumented.append(
-                        (module.relpath, node.lineno, node.name)
+                        (module.path, module.relpath, node.lineno, node.name)
                     )
         if not total:
             return
         rate = documented / total
         if rate < REQUIRED_RATE:
             examples = ", ".join(
-                f"{rel}:{name}" for rel, _line, name in undocumented[:10]
+                f"{rel}:{name}" for _path, rel, _line, name in undocumented[:10]
             )
-            rel, line, _name = undocumented[0]
-            yield rel, line, (
+            path, _rel, line, _name = undocumented[0]
+            yield path, line, (
                 f"public docstring coverage {rate:.0%} < "
                 f"{REQUIRED_RATE:.0%} across the analyzed tree "
                 f"(undocumented: {examples})"

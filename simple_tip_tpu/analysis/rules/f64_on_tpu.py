@@ -47,6 +47,7 @@ class F64OnTpuRule(Rule):
     )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Flag float64 dtype requests in device-adjacent modules."""
         if not module.relpath.startswith(DEVICE_ADJACENT_PREFIXES):
             return
         if module.relpath in ALLOWLIST:

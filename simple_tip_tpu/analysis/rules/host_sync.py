@@ -48,6 +48,7 @@ class HostSyncRule(Rule):
     )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Flag implicit syncs and traced-value branches in hot paths."""
         if not module.relpath.startswith(HOT_PATH_PREFIXES):
             return
         aliases = import_aliases(module.tree)

@@ -44,6 +44,20 @@ def _is_static_shape_expr(node: ast.AST) -> bool:
     return False
 
 
+def iter_impurities(fn, aliases) -> Iterator[Tuple[int, str]]:
+    """(line, message) for every impure/concretizing construct in ``fn``'s
+    body, deduplicated by line. The building block shared by the local
+    ``jit-purity`` rule and the call-graph-walking ``transitive-jit-purity``
+    rule (rules/transitive_purity.py), which applies it to helpers reached
+    from traced code in OTHER modules."""
+    seen = set()
+    for node in function_body_nodes(fn):
+        for _rel, line, msg in _check_node(node, aliases):
+            if line not in seen:
+                seen.add(line)
+                yield line, msg
+
+
 @register
 class JitPurityRule(Rule):
     """Flag impure / concretizing constructs inside traced functions."""
@@ -56,58 +70,60 @@ class JitPurityRule(Rule):
     )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Run the impurity checks over every jit-reachable function."""
         aliases = import_aliases(module.tree)
         reachable = jit_reachable_functions(module.tree, aliases)
         seen = set()
         for fn in reachable:
             for node in function_body_nodes(fn):
-                for finding in self._check_node(node, aliases):
+                for finding in _check_node(node, aliases):
                     key = finding[:2]
                     if key not in seen:
                         seen.add(key)
                         yield finding
 
-    def _check_node(self, node, aliases):
-        rel = ""  # filled in by the driver (relpath comes from the module)
-        if isinstance(node, (ast.Global, ast.Nonlocal)):
-            kind = "global" if isinstance(node, ast.Global) else "nonlocal"
-            yield rel, node.lineno, (
-                f"`{kind} {', '.join(node.names)}` inside a traced function: "
-                "closure mutation runs at trace time only"
-            )
+
+def _check_node(node, aliases):
+    rel = ""  # filled in by the driver (relpath comes from the module)
+    if isinstance(node, (ast.Global, ast.Nonlocal)):
+        kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+        yield rel, node.lineno, (
+            f"`{kind} {', '.join(node.names)}` inside a traced function: "
+            "closure mutation runs at trace time only"
+        )
+        return
+    if not isinstance(node, ast.Call):
+        return
+    name = callee_name(node, aliases)
+    if name == "print":
+        yield rel, node.lineno, (
+            "print() inside a traced function executes at trace time "
+            "only; use jax.debug.print while debugging"
+        )
+    elif name is not None and name.startswith("jax.debug."):
+        yield rel, node.lineno, (
+            f"{name}() left in traced code: debug callbacks stall the "
+            "device pipeline in production"
+        )
+    elif name is not None and name.startswith(_HOST_LIB_PREFIXES):
+        # Host-library math over static shape metadata (np.sqrt(x.shape[-1])
+        # and friends) happens once at trace time and is pure — exempt.
+        if node.args and all(_is_static_shape_expr(a) for a in node.args):
             return
-        if not isinstance(node, ast.Call):
-            return
-        name = callee_name(node, aliases)
-        if name == "print":
+        yield rel, node.lineno, (
+            f"host-library call {name}() inside a traced function: "
+            "use jax.numpy, or move the call outside jit"
+        )
+    elif name in _CAST_BUILTINS:
+        if node.args and not any(
+            _is_static_shape_expr(a) for a in node.args
+        ):
             yield rel, node.lineno, (
-                "print() inside a traced function executes at trace time "
-                "only; use jax.debug.print while debugging"
+                f"{name}() on a traced value forces a device->host sync "
+                "inside the program; keep it as a jax array"
             )
-        elif name is not None and name.startswith("jax.debug."):
-            yield rel, node.lineno, (
-                f"{name}() left in traced code: debug callbacks stall the "
-                "device pipeline in production"
-            )
-        elif name is not None and name.startswith(_HOST_LIB_PREFIXES):
-            # Host-library math over static shape metadata (np.sqrt(x.shape[-1])
-            # and friends) happens once at trace time and is pure — exempt.
-            if node.args and all(_is_static_shape_expr(a) for a in node.args):
-                return
-            yield rel, node.lineno, (
-                f"host-library call {name}() inside a traced function: "
-                "use jax.numpy, or move the call outside jit"
-            )
-        elif name in _CAST_BUILTINS:
-            if node.args and not any(
-                _is_static_shape_expr(a) for a in node.args
-            ):
-                yield rel, node.lineno, (
-                    f"{name}() on a traced value forces a device->host sync "
-                    "inside the program; keep it as a jax array"
-                )
-        elif isinstance(node.func, ast.Attribute) and node.func.attr == "item":
-            yield rel, node.lineno, (
-                ".item() inside a traced function concretizes a traced "
-                "value; return the array and read it on host"
-            )
+    elif isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+        yield rel, node.lineno, (
+            ".item() inside a traced function concretizes a traced "
+            "value; return the array and read it on host"
+        )

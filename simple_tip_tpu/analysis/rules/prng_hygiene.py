@@ -63,6 +63,7 @@ class PrngHygieneRule(Rule):
     )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Flag PRNG keys consumed more than once without split/fold_in."""
         aliases = import_aliases(module.tree)
         scopes: List[List[ast.stmt]] = [module.tree.body]
         for node in ast.walk(module.tree):

@@ -1,0 +1,162 @@
+"""Rule ``shape-polymorphism``: concrete-shape escapes inside traced code.
+
+Inside a traced function, ``x.shape`` components are Python ints *today* —
+and every place one escapes into Python-level control flow or a baked
+literal is a landmine for the shape-polymorphic regimes this framework is
+growing into: ``jax.export`` with symbolic dimensions, dynamic batch sizes,
+re-tracing per shape. The TF→JAX migration literature (PAPERS.md) ranks
+concrete-shape assumptions alongside sharding drift as the dominant
+migration defect classes; a reproduction package migrated from TF 2.6.1
+needs a gate for exactly these.
+
+Flags, inside jit-reachable functions (``common.jit_reachable_functions`` —
+jit/vmap/scan/shard_map/pallas kernels):
+
+- Python ``if``/``while`` tests on a traced dimension (``x.shape``/
+  ``x.size`` or a cast of one): under a symbolic dimension the comparison
+  raises; under re-tracing it silently bakes one branch per shape. Use
+  ``jax.lax.cond`` or hoist the decision out of the traced function.
+- Python ``for`` loops bounded by a traced dimension (``range(x.shape[0])``
+  and friends): the loop unrolls at trace time into shape-specific programs
+  (compile-time blowup) and breaks under symbolic dims. Use
+  ``jax.lax.fori_loop``/``scan``.
+- ``len(<arg>)`` on a traced function argument: concretizes the leading
+  dimension as a Python int. ``x.shape[0]`` survives ``jax.export``
+  symbolic dimensions; ``len`` never does.
+- fully-literal ``reshape`` target shapes (every dim a constant, at least
+  one > 1): the array's true factorization is baked in, so the first
+  different channel count / batch size silently mis-folds or errors at
+  trace time. Derive dims from ``x.shape`` (or ``-1``) instead.
+
+All checks are per-function and purely syntactic; whether the function is
+traced AT ALL may be decided in another module (shard_map/pallas_call
+boundaries) — that reachability extension lives in ``common`` and the
+project graph.
+"""
+
+import ast
+from typing import Iterator, Optional, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.common import (
+    callee_name,
+    function_body_nodes,
+    import_aliases,
+    jit_reachable_functions,
+    lambda_or_def_params,
+)
+
+_DIM_ATTRS = ("shape", "size")
+
+
+def _mentions_traced_dim(node: ast.AST) -> Optional[str]:
+    """The dotted-ish source of a traced-dimension reference in ``node``
+    (e.g. ``x.shape``), or None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _DIM_ATTRS:
+            base = sub.value
+            label = base.id if isinstance(base, ast.Name) else "..."
+            return f"{label}.{sub.attr}"
+    return None
+
+
+def _literal_reshape_dims(call: ast.Call, aliases) -> Optional[Tuple[int, ...]]:
+    """The fully-literal target shape of a reshape call, or None.
+
+    Matches ``x.reshape(a, b, ...)`` / ``x.reshape((a, b))`` and
+    ``jnp.reshape(x, (a, b))`` where EVERY dim is an int constant. Mixed
+    shapes (some dims derived from ``x.shape``) and ``-1`` wildcards are
+    fine — only a completely baked shape is a finding.
+    """
+    name = callee_name(call, aliases)
+    if name in ("jax.numpy.reshape", "numpy.reshape"):
+        dim_args = call.args[1:]
+    elif isinstance(call.func, ast.Attribute) and call.func.attr == "reshape":
+        dim_args = list(call.args)
+    else:
+        return None
+    if not dim_args:
+        return None
+    if len(dim_args) == 1 and isinstance(dim_args[0], (ast.Tuple, ast.List)):
+        dim_args = list(dim_args[0].elts)
+    dims = []
+    for arg in dim_args:
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, int)):
+            return None
+        dims.append(arg.value)
+    if not any(d > 1 for d in dims):
+        return None  # reshape(-1), reshape(1, -1): layout-only, shape-safe
+    return tuple(dims)
+
+
+@register
+class ShapePolymorphismRule(Rule):
+    """Flag concrete-shape escapes inside traced functions."""
+
+    name = "shape-polymorphism"
+    description = (
+        "Python control flow on traced dimensions, len() on traced "
+        "arguments and fully-literal reshape shapes inside traced "
+        "functions — the concrete-shape assumptions that break under "
+        "jax.export / dynamic batch sizes"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Flag concrete-shape escapes in the module's traced functions."""
+        aliases = import_aliases(module.tree)
+        reachable = jit_reachable_functions(module.tree, aliases)
+        seen = set()
+        for fn in reachable:
+            params = set(lambda_or_def_params(fn))
+            for node in function_body_nodes(fn):
+                for line, msg in self._check_node(node, params, aliases):
+                    if line not in seen:
+                        seen.add(line)
+                        yield "", line, msg
+
+    def _check_node(self, node, params, aliases):
+        if isinstance(node, (ast.If, ast.While)):
+            hit = _mentions_traced_dim(node.test)
+            if hit is not None:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield node.lineno, (
+                    f"Python `{kind}` on a traced dimension ({hit}) inside "
+                    "a traced function: bakes one branch per shape and "
+                    "breaks under jax.export symbolic dims; use "
+                    "jax.lax.cond or hoist the decision out of the trace"
+                )
+        elif isinstance(node, ast.For):
+            if isinstance(node.iter, ast.Call) and callee_name(
+                node.iter, aliases
+            ) in ("range", "builtins.range"):
+                hit = _mentions_traced_dim(node.iter)
+                if hit is not None:
+                    yield node.lineno, (
+                        f"Python `for` bounded by a traced dimension ({hit}) "
+                        "inside a traced function: unrolls at trace time "
+                        "per shape; use jax.lax.fori_loop or scan"
+                    )
+        elif isinstance(node, ast.Call):
+            name = callee_name(node, aliases)
+            if (
+                name == "len"
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in params
+            ):
+                yield node.lineno, (
+                    f"len({node.args[0].id}) on a traced function argument "
+                    "concretizes its leading dimension; use "
+                    f"{node.args[0].id}.shape[0], which survives jax.export "
+                    "symbolic dims"
+                )
+            else:
+                dims = _literal_reshape_dims(node, aliases)
+                if dims is not None:
+                    shape = ", ".join(str(d) for d in dims)
+                    yield node.lineno, (
+                        f"reshape({shape}) bakes a fully-literal shape into "
+                        "traced code: the first different channel/batch size "
+                        "mis-folds silently; derive dims from the operand's "
+                        ".shape (or use -1)"
+                    )

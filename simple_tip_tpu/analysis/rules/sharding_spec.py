@@ -1,0 +1,74 @@
+"""Rule ``sharding-spec-mismatch``: PartitionSpec axes must exist on a mesh.
+
+A ``PartitionSpec`` naming an axis no mesh declares is the classic
+pjit/shard_map deployment bug: nothing catches it at trace time on a
+single-device dev box (the spec is dead weight there), and on the real pod
+slice it explodes at dispatch — or worse, a typo'd axis silently means
+"replicated" in contexts that tolerate unknown axes, so the program runs
+with 1/N of the intended parallelism. The TF→JAX migration literature
+(PAPERS.md) names sharding-spec drift as a dominant migration defect class.
+
+Whole-program by construction: mesh axis names are declared where meshes
+are BUILT (``parallel/ensemble.py`` ``Mesh(devs, (ENSEMBLE_AXIS,
+DATA_AXIS))``, ``parallel/ring_attention.py`` ``Mesh(devs, ("sp",))``) while
+``PartitionSpec`` literals appear wherever arrays are laid out — other
+modules entirely. The project graph (``analysis.graph``) indexes both sides,
+resolving axis-name strings through module-level constants and cross-module
+imports of them.
+
+Findings: every string axis in a ``PartitionSpec(...)`` literal that matches
+no axis name of any mesh constructed anywhere in the analyzed project.
+
+Conservatism: if ANY mesh site's axis tuple failed to resolve statically
+(axis names computed at runtime), the rule stays silent — an unknown mesh
+could declare the axis. Dynamic spec axes (variables, ``self.seq_axis``)
+are likewise skipped. No mesh constructions at all → silent (nothing to
+check against).
+"""
+
+from typing import Iterator, Sequence, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+
+
+@register
+class ShardingSpecMismatchRule(Rule):
+    """Check PartitionSpec axis literals against constructed mesh axes."""
+
+    name = "sharding-spec-mismatch"
+    description = (
+        "PartitionSpec axis names that match no axis of any mesh "
+        "constructed in the analyzed project (cross-module, via the "
+        "project graph)"
+    )
+
+    def check_package(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Tuple[str, int, str]]:
+        """Check every resolved PartitionSpec axis against the mesh axes."""
+        # Deferred import: analysis.graph itself imports rules.common, so a
+        # module-level import here would cycle through rules/__init__.
+        from simple_tip_tpu.analysis.graph import project_graph
+
+        graph = project_graph(modules)
+        if not graph.meshes:
+            return
+        if not all(site.complete for site in graph.meshes):
+            return  # a dynamically-named mesh could declare anything
+        known = set()
+        for site in graph.meshes:
+            known.update(site.axes)
+        declared = ", ".join(sorted(known)) or "<none>"
+        sites = ", ".join(
+            sorted({f"{s.module.relpath}:{s.line}" for s in graph.meshes})
+        )
+        for spec in graph.specs:
+            for axis in spec.axes:
+                if axis in known:
+                    continue
+                yield spec.module.path, spec.line, (
+                    f"PartitionSpec axis '{axis}' is not an axis of any "
+                    f"mesh constructed in this project (declared axes: "
+                    f"{declared}; meshes at {sites}); on a real mesh this "
+                    "fails at dispatch or silently replicates"
+                )

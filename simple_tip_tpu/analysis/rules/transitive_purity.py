@@ -1,0 +1,120 @@
+"""Rule ``transitive-jit-purity``: impurity reached THROUGH the call graph.
+
+The local ``jit-purity`` rule sees one module at a time, so the classic
+failure slips through: a jitted function in ``a.py`` calls a helper that
+lives in ``b.py``, and the helper prints, mutates a global, or calls numpy.
+The helper's own module gives no hint it is device code — nothing flags it
+locally — yet under trace its side effects run once at trace time and its
+numpy calls break tracing. Whole-program reasoning is exactly what made
+full-program TPU compilation workable in the Julia→TPU work (PAPERS.md);
+this rule is the lint-time analogue.
+
+Mechanics (on top of ``analysis.graph.ProjectGraph``):
+
+- every *traced entry* — a function locally jit-reachable in its own
+  module, or one traced from ANOTHER module via a jit/shard_map/pallas_call
+  boundary the graph resolved — is a root;
+- the rule walks resolvable call edges (bare names, imported names,
+  ``mod.fn`` chains, ``functools.partial`` wrappers) breadth-first from
+  each root, bounded in depth, skipping callees that are locally
+  jit-reachable in their own module (the per-file rule already covers
+  them — no duplicate findings);
+- an impure construct (the ``jit_purity.iter_impurities`` checks) found in
+  a callee is flagged **at the call site inside traced code**, with the
+  full call chain printed: the line a reviewer must change is where traced
+  code commits to the impure helper, not the helper itself (which may be
+  perfectly fine as host code).
+
+For a function traced only cross-module, its OWN body impurities are also
+reported — at the boundary that traces it (e.g. the ``shard_map`` call
+site), since no local rule will ever look inside it.
+"""
+
+from typing import Iterator, List, Sequence, Set, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.common import import_aliases
+from simple_tip_tpu.analysis.rules.jit_purity import iter_impurities
+
+MAX_DEPTH = 6
+
+
+def _impurities(fi) -> List[Tuple[int, str]]:
+    """Impure (line, message) pairs in one FunctionInfo's body."""
+    aliases = import_aliases(fi.module.tree)
+    return list(iter_impurities(fi.node, aliases))
+
+
+@register
+class TransitiveJitPurityRule(Rule):
+    """Propagate the jit-purity checks through the project call graph."""
+
+    name = "transitive-jit-purity"
+    description = (
+        "impure helpers (print/numpy/global mutation/concretization) "
+        "reached from traced code through cross-module call chains, "
+        "flagged at the call site with the chain printed"
+    )
+
+    def check_package(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Tuple[str, int, str]]:
+        """Walk the call graph from every traced entry, flagging impurity."""
+        # Deferred import: analysis.graph imports rules.common, so importing
+        # it at module level would cycle through rules/__init__.
+        from simple_tip_tpu.analysis.graph import project_graph
+
+        graph = project_graph(modules)
+        reported: Set[Tuple[str, int, str, int]] = set()
+
+        for entry, boundary in graph.traced_entries():
+            # A cross-module-only entry is never scanned by the local rule:
+            # surface its own impurities at the boundary that traces it.
+            if boundary is not None:
+                for line, msg in _impurities(entry):
+                    key = (boundary.module.path, boundary.line, entry.dotted, line)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    yield boundary.module.path, boundary.line, (
+                        f"{boundary.transform}({entry.qualname}) traces "
+                        f"{entry.dotted} ({entry.module.relpath}:{line}), "
+                        f"which is impure there: {msg}"
+                    )
+            # Findings anchor at the FIRST call site inside the traced
+            # entry — the line where traced code commits to the (eventual)
+            # impure helper — no matter how deep the chain goes from there.
+            for call, callee in graph.calls_from(entry.module, entry.node):
+                yield from self._walk(
+                    graph, callee, [entry, callee],
+                    entry.module, call.lineno, reported,
+                )
+
+    def _walk(
+        self,
+        graph,
+        fi,
+        chain: List,
+        anchor_module: ModuleInfo,
+        anchor_line: int,
+        reported: Set[Tuple[str, int, str, int]],
+    ) -> Iterator[Tuple[str, int, str]]:
+        if len(chain) > MAX_DEPTH or fi in chain[:-1]:
+            return  # depth bound / recursion cycle
+        if fi.node in graph.jit_reachable(fi.module):
+            return  # the local jit-purity rule owns this function
+        for line, msg in _impurities(fi):
+            key = (anchor_module.path, anchor_line, fi.dotted, line)
+            if key in reported:
+                continue
+            reported.add(key)
+            path = " -> ".join(f.qualname for f in chain)
+            yield anchor_module.path, anchor_line, (
+                f"traced call chain {path} reaches impure code in "
+                f"{fi.dotted} ({fi.module.relpath}:{line}): {msg}"
+            )
+        for _call, callee in graph.calls_from(fi.module, fi.node):
+            yield from self._walk(
+                graph, callee, chain + [callee],
+                anchor_module, anchor_line, reported,
+            )

@@ -103,6 +103,7 @@ class KMeans:
         return self.labels_
 
     def fit(self, x: np.ndarray) -> "KMeans":
+        """Fit the estimator (sklearn-compatible); returns self."""
         self.fit_predict(x)
         return self
 

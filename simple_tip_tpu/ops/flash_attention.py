@@ -112,7 +112,7 @@ def _flash_fwd_call(q, k, v, kv_len: int, block_q: int, block_kv: int, interpret
     g, t_q, dh = q.shape
     t_kv = k.shape[1]
     n_q, n_kv = t_q // block_q, t_kv // block_kv
-    scale = np.float32(1.0 / np.sqrt(dh))
+    scale = np.float32(1.0 / np.sqrt(q.shape[-1]))
     kernel = functools.partial(
         _flash_kernel, scale=scale, kv_len=kv_len, n_kv=n_kv
     )
@@ -239,7 +239,7 @@ def _flash_bwd_call(q, k, v, out, lse, do, kv_len, block_q, block_kv, interpret)
     g, t_q, dh = q.shape
     t_kv = k.shape[1]
     n_q, n_kv = t_q // block_q, t_kv // block_kv
-    scale = np.float32(1.0 / np.sqrt(dh))
+    scale = np.float32(1.0 / np.sqrt(q.shape[-1]))
     # D in f32 (from the f32 out), then dO in the forward's compute dtype so
     # every backward matmul runs MXU-native when the forward did.
     dvec = jnp.sum(do * out, axis=-1)[:, None, :]  # [g, 1, t_q], like lse

@@ -86,7 +86,9 @@ def _mnist_kernel(
         ],
         axis=-1,
     )  # [TB, 11, 11, 288] in (dy, dx, c) channel order
-    w2 = w2_ref[...].astype(cdt).reshape(288, 64)  # same (dy, dx, c) rows
+    # same (dy, dx, c) rows; [3, 3, 32, 64] -> [288, 64] derived from the
+    # weight ref itself so a different channel stack can't silently mis-fold
+    w2 = w2_ref[...].astype(cdt).reshape(-1, w2_ref.shape[-1])
     h2 = jax.lax.dot_general(
         patches.reshape(tb * 121, 288),
         w2,
@@ -292,6 +294,7 @@ def fused_mnist_probs(
 
 
 def fused_available() -> bool:
+    """Whether the Pallas fused-forward kernels can run in this build."""
     return HAVE_PALLAS
 
 

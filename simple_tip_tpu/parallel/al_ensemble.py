@@ -99,6 +99,7 @@ def make_al_epoch_core(model, tx, batch_size: int):
 
 
 def optax_apply(params, updates):
+    """Apply optax updates (lazy import keeps module import light)."""
     import optax
 
     return optax.apply_updates(params, updates)

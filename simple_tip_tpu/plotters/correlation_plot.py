@@ -80,6 +80,7 @@ class WilcoxonCorrelationPlot:
 
     @property
     def measurements(self) -> Dict[str, Dict[Hashable, float]]:
+        """approach -> {sample id -> value}, as collected so far."""
         return self._samples
 
     def _paired(self, a: str, b: str) -> Tuple[List[float], List[float]]:

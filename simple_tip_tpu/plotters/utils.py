@@ -117,6 +117,7 @@ def human_approach_name(approach: str) -> str:
 
 
 def human_approach_names(approaches: List[str]) -> List[str]:
+    """Vectorized :func:`human_approach_name`."""
     return [human_approach_name(a) for a in approaches]
 
 

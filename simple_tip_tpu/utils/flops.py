@@ -41,6 +41,7 @@ def conv2d_flops(h_out: int, w_out: int, c_out: int, kh: int, kw: int, c_in: int
 
 
 def dense_flops(n_in: int, n_out: int) -> int:
+    """FLOPs of one dense layer forward (multiply-add counted as 2)."""
     return 2 * n_in * n_out
 
 

@@ -19,6 +19,7 @@ BENCH = os.path.join(
 
 @pytest.fixture()
 def bench():
+    """Import bench.py as a module object for the test."""
     spec = importlib.util.spec_from_file_location("bench_under_test", BENCH)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)

@@ -19,6 +19,7 @@ SCRIPT = os.path.join(
 
 @pytest.fixture()
 def harness():
+    """Import the capture script as a module object for the test."""
     spec = importlib.util.spec_from_file_location("capture_tpu_evidence", SCRIPT)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)

@@ -14,6 +14,7 @@ from simple_tip_tpu.models.train import TrainConfig, evaluate_accuracy
 
 @pytest.fixture()
 def tiny_assets(tmp_path, monkeypatch):
+    """Isolated TIP_ASSETS/TIP_DATA_DIR sandbox for one e2e run."""
     monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "assets"))
     monkeypatch.setenv("TIP_DATA_DIR", str(tmp_path / "nonexistent-data"))
     return tmp_path

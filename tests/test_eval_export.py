@@ -18,6 +18,7 @@ SCRIPT = os.path.join(
 
 @pytest.fixture()
 def ex():
+    """Import scripts/eval_export.py as a module object for the test."""
     spec = importlib.util.spec_from_file_location("eval_export", SCRIPT)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)

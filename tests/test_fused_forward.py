@@ -22,6 +22,7 @@ if not fused_forward.fused_available():  # pragma: no cover
 
 @pytest.fixture(scope="module")
 def params():
+    """Trained-shape random MNIST convnet params (fixture)."""
     return init_params(
         MnistConvNet(), jax.random.PRNGKey(0), np.zeros((1, 28, 28, 1), np.float32)
     )
@@ -68,6 +69,7 @@ def test_fused_probs_are_distributions(params):
 
 @pytest.fixture(scope="module")
 def cifar_params():
+    """Trained-shape random CIFAR-10 convnet params (fixture)."""
     from simple_tip_tpu.models import Cifar10ConvNet
 
     return init_params(

@@ -9,6 +9,7 @@ import pytest
 
 @pytest.fixture()
 def data_dir(tmp_path, monkeypatch):
+    """Temp TIP_DATA_DIR with synthetic dataset files (fixture)."""
     d = tmp_path / "datasets"
     d.mkdir()
     monkeypatch.setenv("TIP_DATA_DIR", str(d))

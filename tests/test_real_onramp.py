@@ -18,6 +18,7 @@ from simple_tip_tpu.data import real_onramp
 
 @pytest.fixture()
 def data_dir(tmp_path, monkeypatch):
+    """Temp TIP_DATA_DIR pointing at bundled real-data samples."""
     d = tmp_path / "datasets"
     d.mkdir()
     monkeypatch.setenv("TIP_DATA_DIR", str(d))

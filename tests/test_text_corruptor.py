@@ -24,6 +24,7 @@ BASE = [
 
 @pytest.fixture(scope="module")
 def corruptor(tmp_path_factory):
+    """A TextCorruptor over the bundled thesaurus (fixture)."""
     cache = tmp_path_factory.mktemp("corr-cache")
     return TextCorruptor(base_dataset=BASE, cache_dir=str(cache), dictionary_size=50)
 

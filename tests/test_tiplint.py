@@ -13,6 +13,10 @@ Three layers:
 Pure stdlib on purpose (no jax import): the lint gate must be exercisable
 in dependency-light CI.
 """
+# The fixture strings below embed `tiplint: disable=...` comments as DATA;
+# the line scanner cannot tell them from real suppressions, so they would
+# all report as unused. Nothing in this file needs a real suppression.
+# tiplint: disable-file=unused-suppression (fixture strings embed suppression comments as data)
 
 import json
 import os
@@ -23,10 +27,14 @@ import pytest
 
 from simple_tip_tpu.analysis import analyze_paths, all_rules, unsuppressed
 from simple_tip_tpu.analysis.cli import main
-from simple_tip_tpu.analysis.reporters import json_report, text_report
+from simple_tip_tpu.analysis.graph import ProjectGraph
+from simple_tip_tpu.analysis.core import ModuleInfo
+from simple_tip_tpu.analysis.reporters import github_report, json_report, text_report
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(REPO_ROOT, "simple_tip_tpu")
+SCRIPTS = os.path.join(REPO_ROOT, "scripts")
+TESTS = os.path.join(REPO_ROOT, "tests")
 
 
 def _write(root, relpath, source):
@@ -349,6 +357,150 @@ def alpha():
     "__init__.py": "",  # empty namespace init is exempt
 }
 
+# --- project-graph rule fixtures ---------------------------------------------
+# All three span modules on purpose: the mesh lives in one file, the typo'd
+# PartitionSpec in another; the jitted caller and the impure helper likewise.
+
+BAD_SHARDING = {
+    "meshes.py": '''"""m."""
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+ENSEMBLE_AXIS = "ensemble"
+
+
+def make_mesh():
+    """d."""
+    return Mesh(np.asarray(jax.devices()), (ENSEMBLE_AXIS, "data"))
+''',
+    "layout.py": '''"""Typo'd axis: no mesh anywhere declares 'ensembel'."""
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def shard(mesh, arr):
+    """d."""
+    return NamedSharding(mesh, P("ensembel", None))
+''',
+}
+
+GOOD_SHARDING = {
+    "meshes.py": BAD_SHARDING["meshes.py"],
+    "layout.py": '''"""Axis names resolve through the cross-module constant."""
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from meshes import ENSEMBLE_AXIS
+
+
+def shard(mesh, arr):
+    """d."""
+    return NamedSharding(mesh, P(ENSEMBLE_AXIS, "data"))
+
+
+def replicated(mesh, arr):
+    """Empty and dynamic specs are never findings."""
+    return NamedSharding(mesh, P())
+''',
+}
+
+BAD_SHAPE_POLY = {
+    "mod.py": '''"""m."""
+import jax
+
+
+@jax.jit
+def step(x):
+    """d."""
+    if x.shape[0] > 4:
+        x = x + 1
+    for i in range(x.shape[0]):
+        x = x + i
+    n = len(x)
+    return x.reshape(8, 16) + n
+'''
+}
+
+GOOD_SHAPE_POLY = {
+    "mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    """Shape-derived dims, -1 wildcards and static loops are all fine."""
+    b = x.shape[0]
+    y = x.reshape(b, -1)
+    z = jnp.reshape(y, (-1,))
+    for i in range(3):
+        z = z + i
+    return z
+
+
+def host(xs):
+    """Shape branches and len() on the host side are not findings."""
+    if xs.shape[0] > 2:
+        return len(xs)
+    return 0
+'''
+}
+
+BAD_TRANSITIVE = {
+    "helpers.py": '''"""Host helper module: impure, and fine as host code."""
+import numpy as np
+
+
+def normalize(x):
+    """d."""
+    print("normalizing")
+    return np.log(x)
+''',
+    "train.py": '''"""m."""
+import jax
+
+from helpers import normalize
+
+
+@jax.jit
+def step(x):
+    """d."""
+    return normalize(x) + 1
+''',
+}
+
+GOOD_TRANSITIVE = {
+    "helpers.py": '''"""m."""
+import jax.numpy as jnp
+
+
+def normalize(x):
+    """Pure jnp helper: safe to reach under trace."""
+    return jnp.log(x)
+
+
+def report(x):
+    """Impure, but only ever called from host code."""
+    print("report", x)
+    return x
+''',
+    "train.py": '''"""m."""
+import jax
+
+from helpers import normalize, report
+
+
+@jax.jit
+def step(x):
+    """d."""
+    return normalize(x) + 1
+
+
+def host_loop(xs):
+    """Host callers of impure helpers are fine."""
+    return [report(x) for x in xs]
+''',
+}
+
 FIXTURES = {
     "jit-purity": (BAD_JIT_PURITY, GOOD_JIT_PURITY),
     "prng-hygiene": (BAD_PRNG, GOOD_PRNG),
@@ -357,6 +509,9 @@ FIXTURES = {
     "buffer-donation": (BAD_DONATION, GOOD_DONATION),
     "artifact-contract": (BAD_CONTRACT, GOOD_CONTRACT),
     "docstring-coverage": (BAD_DOCSTRING, GOOD_DOCSTRING),
+    "sharding-spec-mismatch": (BAD_SHARDING, GOOD_SHARDING),
+    "shape-polymorphism": (BAD_SHAPE_POLY, GOOD_SHAPE_POLY),
+    "transitive-jit-purity": (BAD_TRANSITIVE, GOOD_TRANSITIVE),
 }
 
 
@@ -402,6 +557,144 @@ def test_contract_names_both_orphans(tmp_path):
     assert "orphan_bus" in blob
     assert "ghost_bus" in blob
     assert "contract drift" in blob
+
+
+def test_sharding_mismatch_names_axis_and_mesh_site(tmp_path):
+    findings = _run_rule(tmp_path, "sharding-spec-mismatch", BAD_SHARDING)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path == "layout.py"
+    assert "'ensembel'" in f.message
+    assert "ensemble" in f.message and "data" in f.message
+    assert "meshes.py:" in f.message  # points at the mesh construction
+
+
+def test_sharding_silent_without_meshes(tmp_path):
+    spec_only = {"layout.py": BAD_SHARDING["layout.py"]}
+    assert not _run_rule(tmp_path, "sharding-spec-mismatch", spec_only)
+
+
+def test_shape_poly_finds_each_escape(tmp_path):
+    findings = _run_rule(tmp_path, "shape-polymorphism", BAD_SHAPE_POLY)
+    blob = " ".join(f.message for f in findings)
+    for marker in ("`if`", "`for`", "len(x)", "reshape(8, 16)"):
+        assert marker in blob, f"missing {marker!r} in: {blob}"
+
+
+def test_transitive_chain_spans_modules(tmp_path):
+    findings = _run_rule(tmp_path, "transitive-jit-purity", BAD_TRANSITIVE)
+    assert findings, "cross-module impure helper not flagged"
+    # flagged at the call site in the jitted module, naming the chain and
+    # the helper's home module
+    assert all(f.path == "train.py" for f in findings)
+    blob = " ".join(f.message for f in findings)
+    assert "step -> normalize" in blob
+    assert "helpers.py" in blob
+    assert "print()" in blob or "numpy.log" in blob
+
+
+def test_transitive_does_not_duplicate_local_rule(tmp_path):
+    # helper jit-reachable in its OWN module: local jit-purity owns it, the
+    # transitive rule must stay silent (no double reporting).
+    files = {
+        "helpers.py": '"""m."""\n'
+        "import jax\n"
+        "import numpy as np\n"
+        "\n\n"
+        "@jax.jit\n"
+        "def normalize(x):\n"
+        '    """d."""\n'
+        '    print("normalizing")\n'
+        "    return np.log(x)\n",
+        "train.py": BAD_TRANSITIVE["train.py"],
+    }
+    assert not _run_rule(tmp_path, "transitive-jit-purity", files)
+    assert _run_rule(tmp_path, "jit-purity", files)
+
+
+def test_transitive_flags_shard_map_boundary_target(tmp_path):
+    # kernel impure + traced ONLY from another module via shard_map through
+    # a partial binding: flagged at the boundary call site.
+    files = {
+        "kernel.py": '"""m."""\n'
+        "\n\n"
+        "def collective(x, axis_name):\n"
+        '    """d."""\n'
+        '    print("tracing")\n'
+        "    return x\n",
+        "driver.py": '"""m."""\n'
+        "import functools\n"
+        "\n"
+        "import jax\n"
+        "\n"
+        "from kernel import collective\n"
+        "\n\n"
+        "def run(mesh, x):\n"
+        '    """d."""\n'
+        '    fn = functools.partial(collective, axis_name="sp")\n'
+        "    return jax.shard_map(fn, mesh=mesh, in_specs=None, out_specs=None)(x)\n",
+    }
+    findings = _run_rule(tmp_path, "transitive-jit-purity", files)
+    assert findings and all(f.path == "driver.py" for f in findings)
+    blob = " ".join(f.message for f in findings)
+    assert "jax.shard_map" in blob and "kernel.py" in blob
+
+
+# --- project graph -----------------------------------------------------------
+
+
+def _graph(tmp_path, files):
+    root = str(tmp_path / "proj")
+    modules = [
+        ModuleInfo.parse(_write(root, rel, src), root) for rel in sorted(files)
+        for src in [files[rel]]
+    ]
+    return ProjectGraph(modules), modules
+
+
+def test_graph_module_naming_package_vs_flat(tmp_path):
+    graph, modules = _graph(
+        tmp_path,
+        {
+            "__init__.py": '"""p."""\n',
+            "sub/__init__.py": '"""s."""\n',
+            "sub/mod.py": '"""m."""\n\n\ndef f():\n    """d."""\n',
+        },
+    )
+    by_rel = {m.relpath: m for m in modules}
+    assert graph.module_name(by_rel["__init__.py"]) == "proj"
+    assert graph.module_name(by_rel["sub/mod.py"]) == "proj.sub.mod"
+    assert "proj.sub.mod.f" in graph.functions
+
+
+def test_graph_indexes_meshes_specs_and_boundaries(tmp_path):
+    graph, _ = _graph(
+        tmp_path,
+        {
+            "meshes.py": BAD_SHARDING["meshes.py"],
+            "layout.py": BAD_SHARDING["layout.py"],
+            "train.py": BAD_TRANSITIVE["train.py"],
+            "helpers.py": BAD_TRANSITIVE["helpers.py"],
+        },
+    )
+    assert [site.axes for site in graph.meshes] == [("ensemble", "data")]
+    assert graph.meshes[0].complete
+    assert ("ensembel",) in [s.axes for s in graph.specs]
+    targets = {b.target.dotted for b in graph.boundaries if b.target}
+    assert "train.step" in targets  # @jax.jit boundary resolved
+
+
+def test_graph_resolves_constants_across_modules(tmp_path):
+    graph, modules = _graph(
+        tmp_path,
+        {
+            "meshes.py": BAD_SHARDING["meshes.py"],
+            "layout.py": GOOD_SHARDING["layout.py"],
+        },
+    )
+    # the GOOD layout's P(ENSEMBLE_AXIS, "data") resolves via the import
+    spec_axes = sorted(a for s in graph.specs for a in s.axes)
+    assert "ensemble" in spec_axes and "data" in spec_axes
 
 
 # --- framework behavior ------------------------------------------------------
@@ -460,11 +753,166 @@ def test_unrelated_suppression_does_not_apply(tmp_path):
     assert unsuppressed(analyze_paths([root], select=["f64-on-tpu"]))
 
 
+def test_comment_attachment_is_strictly_previous_line(tmp_path):
+    # A suppression comment separated from the finding by a blank line or a
+    # code line attaches to NOTHING (and reports as unused on a full run).
+    root = str(tmp_path / "pkg")
+    _write(
+        root,
+        "ops/mod.py",
+        '"""m."""\n'
+        "import numpy as np\n"
+        "# tiplint: disable=f64-on-tpu (too far away)\n"
+        "\n"
+        "acc = np.zeros(4, dtype=np.float64)\n",
+    )
+    findings = analyze_paths([root], select=["f64-on-tpu"])
+    assert len(unsuppressed(findings)) == 1
+    full = analyze_paths([root])
+    assert any(f.rule == "unused-suppression" and f.line == 3 for f in full)
+
+
+def test_file_level_suppression_works_from_anywhere(tmp_path):
+    # disable-file semantics are positional-free: a trailer at the BOTTOM
+    # still suppresses findings above it.
+    root = str(tmp_path / "pkg")
+    _write(
+        root,
+        "ops/mod.py",
+        '"""m."""\n'
+        "import numpy as np\n"
+        "a = np.zeros(4, dtype=np.float64)\n"
+        "b = np.ones(4, dtype=np.float64)\n"
+        "# tiplint: disable-file=f64-on-tpu (host-exact module)\n",
+    )
+    findings = analyze_paths([root], select=["f64-on-tpu"])
+    assert len(findings) == 2 and not unsuppressed(findings)
+
+
 def test_parse_error_is_reported(tmp_path):
     root = str(tmp_path / "pkg")
     _write(root, "broken.py", "def nope(:\n")
     findings = analyze_paths([root])
     assert any(f.rule == "parse-error" for f in findings)
+
+
+def test_parse_error_is_unsuppressible_and_analysis_continues(tmp_path):
+    # A file that cannot parse has no suppression table: its synthetic
+    # finding always fails the run, and OTHER files still get analyzed.
+    root = str(tmp_path / "pkg")
+    _write(
+        root,
+        "broken.py",
+        "# tiplint: disable-file=parse-error\ndef nope(:\n",
+    )
+    _write(root, "ops/mod.py", '"""m."""\nimport numpy as np\na = np.float64(1)\n')
+    findings = analyze_paths([root], select=["f64-on-tpu"])
+    rules = {f.rule for f in unsuppressed(findings)}
+    assert rules == {"parse-error", "f64-on-tpu"}
+
+
+def test_select_unknown_rule_raises_with_names(tmp_path):
+    root = str(tmp_path / "pkg")
+    _write(root, "mod.py", '"""m."""\n')
+    with pytest.raises(KeyError) as exc:
+        analyze_paths([root], select=["f64-on-tpu", "no-such-rule"])
+    assert "no-such-rule" in str(exc.value)
+    assert "f64-on-tpu" not in str(exc.value)  # known names are not reported
+
+
+def test_relpath_collision_resolves_per_root(tmp_path):
+    # Two roots containing the SAME relative path: the suppression in one
+    # must not leak onto the other (the old by_rel overwrite bug), and the
+    # report paths must disambiguate via the root basename.
+    bad = '"""m."""\nimport numpy as np\na = np.zeros(2, dtype=np.float64)\n'
+    root_a = str(tmp_path / "pkg_a")
+    root_b = str(tmp_path / "pkg_b")
+    _write(
+        root_a,
+        "ops/mod.py",
+        bad.replace(
+            "np.float64)", "np.float64)  # tiplint: disable=f64-on-tpu (host)"
+        ),
+    )
+    _write(root_b, "ops/mod.py", bad)
+    findings = analyze_paths([root_a, root_b], select=["f64-on-tpu"])
+    assert len(findings) == 2
+    active = unsuppressed(findings)
+    assert len(active) == 1
+    assert active[0].path == "pkg_b/ops/mod.py"
+    assert {f.path for f in findings} == {"pkg_a/ops/mod.py", "pkg_b/ops/mod.py"}
+
+
+# --- unused-suppression ------------------------------------------------------
+
+
+def test_unused_suppression_reported_on_full_run(tmp_path):
+    root = str(tmp_path / "pkg")
+    _write(
+        root,
+        "mod.py",
+        '"""m."""\n'
+        "x = 1  # tiplint: disable=f64-on-tpu (left over after refactor)\n",
+    )
+    full = analyze_paths([root])
+    stale = [f for f in full if f.rule == "unused-suppression"]
+    assert len(stale) == 1 and not stale[0].suppressed
+    assert stale[0].line == 2 and "f64-on-tpu" in stale[0].message
+
+
+def test_unused_suppression_silent_under_select(tmp_path):
+    root = str(tmp_path / "pkg")
+    _write(
+        root,
+        "mod.py",
+        '"""m."""\n'
+        "x = 1  # tiplint: disable=f64-on-tpu (left over)\n",
+    )
+    findings = analyze_paths([root], select=["f64-on-tpu"])
+    assert not any(f.rule == "unused-suppression" for f in findings)
+
+
+def test_used_suppression_not_reported(tmp_path):
+    root = str(tmp_path / "pkg")
+    _write(
+        root,
+        "ops/mod.py",
+        '"""m."""\n'
+        "import numpy as np\n"
+        "a = np.zeros(2, dtype=np.float64)  # tiplint: disable=f64-on-tpu (host)\n",
+    )
+    full = analyze_paths([root])
+    assert not any(f.rule == "unused-suppression" for f in full)
+
+
+def test_unknown_rule_suppression_is_flagged(tmp_path):
+    root = str(tmp_path / "pkg")
+    _write(
+        root,
+        "mod.py",
+        '"""m."""\n'
+        "x = 1  # tiplint: disable=f64-on-gpu (typo'd rule name)\n",
+    )
+    stale = [
+        f for f in analyze_paths([root]) if f.rule == "unused-suppression"
+    ]
+    assert len(stale) == 1 and "unknown rule 'f64-on-gpu'" in stale[0].message
+
+
+def test_unused_suppression_is_itself_suppressible(tmp_path):
+    root = str(tmp_path / "pkg")
+    _write(
+        root,
+        "mod.py",
+        '"""m."""\n'
+        "x = 1  # tiplint: disable=f64-on-tpu,unused-suppression (kept on purpose)\n",
+    )
+    full = analyze_paths([root])
+    stale = [f for f in full if f.rule == "unused-suppression"]
+    # the f64 entry is stale but the same line's unused-suppression entry
+    # downgrades it; the downgrading entry itself counts as used.
+    assert len(stale) == 1 and stale[0].suppressed
+    assert not unsuppressed(full)
 
 
 def test_reporters_cover_suppressed_and_active(tmp_path):
@@ -483,6 +931,32 @@ def test_reporters_cover_suppressed_and_active(tmp_path):
     doc = json.loads(json_report(findings))
     assert doc["summary"] == {"total": 2, "unsuppressed": 1, "suppressed": 1}
     assert {f["rule"] for f in doc["findings"]} == {"f64-on-tpu"}
+
+
+def test_github_reporter_emits_workflow_commands(tmp_path):
+    root = str(tmp_path / "pkg")
+    _write(
+        root,
+        "ops/mod.py",
+        '"""m."""\n'
+        "import numpy as np\n"
+        "a = np.zeros(2, dtype=np.float64)\n"
+        "b = np.ones(2, dtype=np.float64)  # tiplint: disable=f64-on-tpu (host)\n",
+    )
+    findings = analyze_paths([root], select=["f64-on-tpu"])
+    out = github_report(findings)
+    lines = out.splitlines()
+    assert lines[0].startswith(
+        "::error file=ops/mod.py,line=3,title=tiplint f64-on-tpu::"
+    )
+    # suppressed findings annotate as notices, so the debt stays visible
+    assert lines[1].startswith("::notice file=ops/mod.py,line=4,")
+    assert lines[1].endswith("(suppressed)")
+    assert lines[-1] == "tiplint: 1 finding(s), 1 suppressed"
+    # messages containing newlines/percent must be workflow-command escaped
+    assert "%" not in out.replace("%25", "").replace("%0A", "").replace(
+        "%0D", ""
+    ).replace("%3A", "").replace("%2C", "")
 
 
 # --- CLI ---------------------------------------------------------------------
@@ -531,6 +1005,17 @@ def test_module_entrypoint_is_wired():
 def test_package_is_lint_clean():
     """The acceptance gate: zero unsuppressed findings over the package."""
     findings = unsuppressed(analyze_paths([PACKAGE]))
+    assert not findings, "tiplint findings:\n" + "\n".join(
+        f.format() for f in findings
+    )
+
+
+def test_whole_project_is_lint_clean():
+    """The widened gate (matches scripts/lint.sh and CI): the package PLUS
+    the scripts/ and tests/ trees analyzed in one run — cross-root module
+    resolution, suppression attribution and the unused-suppression audit
+    all active."""
+    findings = unsuppressed(analyze_paths([PACKAGE, SCRIPTS, TESTS]))
     assert not findings, "tiplint findings:\n" + "\n".join(
         f.format() for f in findings
     )
