@@ -182,9 +182,11 @@ def main() -> int:
     # Exit-code contract (watcher depends on it):
     #   0 = healthy window, capture ran to the end
     #   1 = nothing runnable (tunnel down, no cpu-pinned phases requested)
-    #   2 = mid-study tunnel wedge (window closed; resumable)
-    #   3 = tunnel down, only the cpu-pinned phases ran (NOT a healthy
-    #       window — callers must not fire one-shot device captures on it)
+    #   2 = window closed mid-capture AFTER device work was observed
+    #       (resumable; the window may flap back)
+    #   3 = no device work observed: tunnel down / dead by the first
+    #       per-run probe, at most cpu-pinned phases ran (NOT a window —
+    #       callers must not fire one-shot device captures on it)
     if not tunnel_up:
         # The cpu-pinned study phases don't need the tunnel; bench and the
         # tunnel-bound phases are skipped per-run below and picked up in
@@ -328,7 +330,14 @@ def main() -> int:
     device_window = tunnel_up or saw_device_run
     if device_window and not lost_tunnel:
         return 0  # healthy window throughout the observed device work
-    return 2 if device_window else 3
+    if not saw_device_run:
+        # ADVICE r5: a stale "up" startup probe with the tunnel already dead
+        # at the first per-run probe used to return 2 here — and the watcher
+        # treats 2 as a possibly-open window, burning ~90 s device-probe
+        # timeouts per one-shot capture against a closed window every cycle.
+        # No device work was actually observed: report "no window".
+        return 3
+    return 2
 
 
 def _finalize(study: dict, args) -> None:

@@ -9,12 +9,71 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def verify_hardness_pin(assets: str) -> float:
+    """Pin the synthetic-generator hardness to the assets dir; fail loudly
+    on mismatch (ADVICE r5, medium).
+
+    ``cs.train()`` skips existing checkpoints and the loaders regenerate
+    data from the CURRENT env, so re-running against an assets dir whose
+    checkpoints were trained on another hardness generation (e.g. a
+    pre-hardness r04 bus at hardness 0) would silently evaluate mismatched
+    checkpoints on fresh 0.08-hardness data. Same contract as the study
+    JSON pin in scripts/capture_tpu_evidence.py, but for the mini-study
+    asset bus: the effective hardness is persisted in
+    ``{assets}/synth_hardness.json`` on first generation and verified
+    BEFORE any loader runs. Returns the pinned value.
+    """
+    import json
+
+    from simple_tip_tpu.data.synthetic import _hardness
+
+    effective = _hardness(None)
+    pin_path = os.path.join(assets, "synth_hardness.json")
+    if os.path.exists(pin_path):
+        with open(pin_path) as f:
+            pinned = float(json.load(f)["synth_hardness"])
+        if abs(pinned - effective) > 1e-12:
+            raise SystemExit(
+                f"synthetic-hardness mismatch for assets dir {assets}: its "
+                f"data/checkpoints were generated with TIP_SYNTH_HARDNESS="
+                f"{pinned:g} (pinned in {pin_path}) but this invocation "
+                f"resolves to {effective:g}. Evaluating checkpoints on a "
+                f"different data generation silently corrupts results. "
+                f"Either export TIP_SYNTH_HARDNESS={pinned:g} to resume the "
+                f"existing bus, or delete {assets} to regenerate everything "
+                f"at {effective:g}."
+            )
+        return pinned
+    if os.path.isdir(os.path.join(assets, "models")) and not os.environ.get(
+        "TIP_SYNTH_HARDNESS"
+    ):
+        # Checkpoints exist but the bus predates the pin record: its
+        # generation hardness is unknowable here (pre-hardness buses like
+        # /tmp/mini_study_assets from r04 were generated at 0). Refuse to
+        # guess — an explicit env value adopts that pin instead.
+        raise SystemExit(
+            f"assets dir {assets} has checkpoints but no synth_hardness.json "
+            f"pin (it predates hardness pinning). Export TIP_SYNTH_HARDNESS="
+            f"<value it was generated with> (pre-hardness buses: 0) to adopt "
+            f"the pin, or delete {assets} to regenerate at {effective:g}."
+        )
+    os.makedirs(assets, exist_ok=True)
+    from simple_tip_tpu.utils.artifacts_io import atomic_write_json
+
+    atomic_write_json(pin_path, {"synth_hardness": effective})
+    return effective
+
+
 def bootstrap(assets: str = "/tmp/mini_study_assets") -> None:
     """Env + jax platform binding for a host-side mini-study process."""
     sys.path.insert(0, REPO)
     os.environ.setdefault("TIP_ASSETS", assets)
     os.environ.setdefault("TIP_DATA_DIR", os.path.join(assets, "no-real-data"))
     os.environ["TIP_CASE_STUDY_PROVIDER"] = "simple_tip_tpu.casestudies.mini:provide"
+    # Hardness provenance gate: verify/persist the generator hardness this
+    # bus was built with BEFORE any loader can generate data from a
+    # mismatched env (fails loudly; see verify_hardness_pin).
+    verify_hardness_pin(os.environ["TIP_ASSETS"])
     # Same-backend workers => reproducible artifacts (SCALING.md note).
     os.environ.setdefault("TIP_WORKER_PLATFORMS", "cpu")
     # One AL run is ~80 sequential CPU retrains (~40 min alone, slower under

@@ -21,10 +21,11 @@
 #      Hardness provenance is recorded in the study JSON at creation.
 #
 # Exit-code gate (round-4 advisor finding): capture_tpu_evidence returns
-# 0 = healthy-window capture, 2 = mid-window drop, 3 = tunnel down and only
-# cpu-pinned phases ran. One-shot device captures fire on 0/2 ONLY — rc 3
-# means no window, and probing device scripts then would just burn ~90 s
-# watchdog timeouts every cycle.
+# 0 = healthy-window capture, 2 = window dropped after device work was
+# observed, 3 = no device work observed (tunnel down, or dead by the first
+# per-run probe — ADVICE r5). One-shot device captures fire on 0/2 ONLY —
+# rc 3 means no window, and probing device scripts then would just burn
+# ~90 s watchdog timeouts every cycle.
 #
 # Usage: nohup bash scripts/tunnel_watch.sh >/tmp/tunnel_watch.log 2>&1 &
 set -u

@@ -28,7 +28,9 @@ Findings:
 
 Exempt buses: ``results`` (terminal plot/table output), ``models``
 (engine-internal checkpoints), ``activations``/``.tmp`` (engine-internal
-spill, bounded and self-consumed).
+spill, bounded and self-consumed), ``sa_fit_cache`` (engine-internal
+fitted-scorer cache, written AND read by the engine across processes —
+engine/sa_prep.py; plotters never touch it).
 """
 
 import ast
@@ -39,7 +41,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
 from simple_tip_tpu.analysis.rules.common import callee_name, import_aliases, parent_map
 
-EXEMPT_BUSES = {"results", "models", "activations", ".tmp"}
+EXEMPT_BUSES = {"results", "models", "activations", ".tmp", "sa_fit_cache"}
 WRITER_PREFIXES = ("engine/",)
 READER_PREFIXES = ("plotters/", "utils/")
 ARTIFACT_SUFFIXES = {".npy", ".pickle", ".pkl", ".msgpack"}

@@ -127,6 +127,8 @@ def evaluate(
             sa_activation_layers,
             num_selected,
             dsa_badge_size,
+            case_study=case_study,
+            model_id=model_id,
         )
     )
     selections.update(_get_random_section(active_datasets, num_selected))
@@ -262,11 +264,22 @@ def _get_sa_selection(
     sa_activation_layers: List[int],
     num_selected: int,
     dsa_badge_size: Optional[int] = None,
+    case_study: Optional[str] = None,
+    model_id: Optional[int] = None,
 ) -> MetricSelection:
-    """Selection by surprise-adequacy top-k and SC-CAM-first-k."""
+    """Selection by surprise-adequacy top-k and SC-CAM-first-k.
+
+    ``case_study``/``model_id`` key the SA fit cache: the prio phase fits
+    the same (model, train set, sa_layers) triple, so this phase normally
+    runs against a warm cache and skips every fit (engine/sa_prep.py)."""
     res: MetricSelection = {}
     sa_worker = SurpriseHandler(
-        model_def, params, sa_layers=sa_activation_layers, training_dataset=train_x
+        model_def,
+        params,
+        sa_layers=sa_activation_layers,
+        training_dataset=train_x,
+        case_study=case_study,
+        model_id=model_id,
     )
     results = sa_worker.evaluate_all(
         datasets={NOM: datasets[NOM, OBS][0], OOD: datasets[OOD, OBS][0]},

@@ -133,7 +133,12 @@ def _eval_surprise(
     dsa_badge_size: Optional[int] = None,
 ):
     sa_worker = SurpriseHandler(
-        model_def, params, sa_layers=layers, training_dataset=training_dataset
+        model_def,
+        params,
+        sa_layers=layers,
+        training_dataset=training_dataset,
+        case_study=case_study,
+        model_id=model_id,
     )
     results = sa_worker.evaluate_all(
         datasets={"nominal": nominal_test_dataset, "ood": ood_test_dataset},

@@ -1,0 +1,408 @@
+"""Shared-preparation, fit-parallelism and persistence for the SA engine.
+
+``HOST_PHASE.json`` locates ~243 s of the 536 s per-run test-prio host tail
+in surprise-adequacy *setup* (pc-mlsa 91.9 s, pc-mmdsa 75.6 s, pc-mdsa
+50.9 s, pc-lsa 12.9 s, dsa 11.8 s) — pure host work that serializes across
+all 100 runs no matter how fast the chip is. Three structural facts make it
+attackable (Podracer's lesson, PAPERS.md: keep host preparation pipelined
+against device work rather than letting either idle):
+
+1. **The prep is redundant.** Each per-class variant independently
+   re-flattens the train ATs and re-partitions them by predicted class.
+   ``SharedTrainPrep`` computes the flatten and the by-class partition
+   (index arrays + per-class AT views) ONCE, shared by pc-lsa / pc-mdsa /
+   pc-mlsa (pc-mmdsa and dsa share the flatten). The shared cost is
+   attributed to each consuming variant's ``[setup, pred, quant, cam]``
+   record via the same time-debit scheme ``CoverageWorker`` uses for its
+   shared aggregate statistics (engine/coverage_handler.py), so the
+   reference's timing contract is preserved.
+2. **The fits are embarrassingly parallel.** The ~10 per-class constructors
+   of each per-class variant, pc-mmdsa's per-cluster MDSA fits, and the
+   KMeans candidate-k fits are independent seeded computations.
+   ``FitPool`` fans them over a bounded spawn-based process pool
+   (``TIP_SA_POOL``); every fit is seeded, so the results are
+   bit-identical to the serial path (pinned by tests/test_sa_prep.py).
+3. **The fits are re-run needlessly.** The "fitted once, shared by the
+   prio and AL phases" claim only held within one process, and
+   ``run_scheduler`` spawns a fresh interpreter per phase. ``SAFitCache``
+   persists fitted scorers on disk keyed by (case study, model id,
+   sa_layers, train-set fingerprint), so the AL phase and scheduler
+   restarts/requeues reuse the prio-phase fits instead of refitting.
+
+Module import stays jax-free on purpose: the pool's spawned workers import
+this module, and host-side sklearn/numpy fits must never pay (or wedge on)
+an accelerator-backend initialization.
+"""
+
+import hashlib
+import logging
+import os
+import pickle
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from simple_tip_tpu.ops.surprise import (
+    DSA,
+    LSA,
+    MDSA,
+    MLSA,
+    MultiModalSA,
+    _by_class_discriminator,
+    _class_predictions,
+    _flatten_layers,
+    _flatten_predictions,
+    _KmeansDiscriminator,
+    resolved_cluster_backend,
+)
+from simple_tip_tpu.ops.timer import Timer
+
+logger = logging.getLogger(__name__)
+
+#: Bump when the cache entry layout or any fit hyperparameter baked into the
+#: registry changes; stale-version entries are treated as misses.
+CACHE_FORMAT_VERSION = "sa-fit-cache-v1"
+
+# Per-modal constructors by picklable kind-name (the pool ships kind strings,
+# never closures). Must mirror the modal lambdas of the tested registry
+# (engine/surprise_handler.SA_VARIANTS); parity is pinned by test_sa_prep.
+_MODAL_KINDS: Dict[str, Callable] = {
+    "lsa": lambda acts, preds: LSA(acts),
+    "mdsa": lambda acts, preds: MDSA(acts),
+    "mlsa3": lambda acts, preds: MLSA(acts, num_components=3),
+}
+
+#: Per-class modal kind of each by-class registry variant.
+BY_CLASS_MODAL = {"pc-lsa": "lsa", "pc-mdsa": "mdsa", "pc-mlsa": "mlsa3"}
+
+
+def _fit_modal_task(task):
+    """Fit ONE modal SA instance (runs in a pool worker or inline).
+
+    ``task`` = (modal_id, kind, activations, predictions); returns
+    (modal_id, fitted SA). Top-level so spawn can pickle it.
+    """
+    modal_id, kind, acts, preds = task
+    return modal_id, _MODAL_KINDS[kind](acts, preds)
+
+
+def _pool_worker_init(env: Dict[str, str]) -> None:
+    """Pool-worker initializer: pin the resolved env before any fit runs.
+
+    Pins ``TIP_CLUSTER_BACKEND`` to the PARENT's resolved choice (a worker
+    re-resolving ``auto`` would import jax and probe a possibly-dead
+    tunnel) and ``JAX_PLATFORMS=cpu`` as a belt-and-braces guard — pooled
+    fits are host-side sklearn/numpy by policy (see ``pool_size``).
+    """
+    os.environ.update(env)
+
+
+def pool_size() -> int:
+    """Bounded fit-pool size from ``TIP_SA_POOL`` (≤1 disables the pool).
+
+    ``auto`` (default): 1 on hosts with ≤2 cores (spawn + pickling overhead
+    would exceed the win — measured single-core host, SCALING.md), else
+    ``min(8, cpu_count - 1)`` so the pool never starves the scoring/device
+    thread. An explicit integer forces that size.
+    """
+    raw = os.environ.get("TIP_SA_POOL", "auto").strip().lower()
+    if raw in ("", "auto"):
+        cores = os.cpu_count() or 1
+        return 1 if cores <= 2 else min(8, cores - 1)
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        raise ValueError(f"TIP_SA_POOL={raw!r} not recognized (auto or an int)")
+
+
+def pipeline_enabled() -> bool:
+    """Whether ``evaluate_all`` overlaps variant *i*'s scoring with variant
+    *i+1*'s host fit (``TIP_SA_PIPELINE``, default on; ``0``/``off`` disables)."""
+    raw = os.environ.get("TIP_SA_PIPELINE", "auto").strip().lower()
+    if raw in ("", "auto", "1", "on"):
+        return True
+    if raw in ("0", "off"):
+        return False
+    raise ValueError(f"TIP_SA_PIPELINE={raw!r} not recognized (auto, 1, 0)")
+
+
+class FitPool:
+    """Bounded spawn-based process pool for independent seeded SA fits.
+
+    ``spawn`` (never ``fork``) follows the repo-wide policy
+    (parallel/run_scheduler.py): a forked child could inherit initialized
+    backend/tunnel state. Workers only ever run host-side sklearn/numpy
+    fits, so their startup cost is an interpreter + numpy/sklearn import,
+    not a jax init. Any pool-level failure (a worker OOM-killed, a broken
+    pipe) degrades to the serial in-process path with a warning — the pool
+    is an optimization, never a correctness dependency.
+    """
+
+    def __init__(self, processes: int):
+        self.processes = processes
+        self._executor = None
+
+    def _ensure(self):
+        from concurrent.futures import ProcessPoolExecutor
+        import multiprocessing as mp
+
+        if self._executor is None:
+            env = {
+                "TIP_CLUSTER_BACKEND": resolved_cluster_backend(),
+                "JAX_PLATFORMS": "cpu",
+            }
+            self._executor = ProcessPoolExecutor(
+                max_workers=self.processes,
+                mp_context=mp.get_context("spawn"),
+                initializer=_pool_worker_init,
+                initargs=(env,),
+            )
+        return self._executor
+
+    def map(self, fn: Callable, tasks: Sequence) -> List:
+        """``[fn(t) for t in tasks]`` across the pool, order-preserving;
+        falls back to the serial path if the pool breaks."""
+        import multiprocessing as mp
+
+        # run_scheduler workers are daemonic and may not spawn children;
+        # inside one, the run-level parallelism already owns the cores.
+        if (
+            self.processes <= 1
+            or len(tasks) <= 1
+            or mp.current_process().daemon
+        ):
+            return [fn(t) for t in tasks]
+        try:
+            return list(self._ensure().map(fn, tasks))
+        except Exception as e:  # noqa: BLE001 — any pool failure degrades to serial
+            logger.warning("SA fit pool failed (%r); refitting serially", e)
+            self.close()
+            return [fn(t) for t in tasks]
+
+    def close(self) -> None:
+        """Shut the pool down (idempotent)."""
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
+
+
+class SharedTrainPrep:
+    """Flatten + by-class partition of the train ATs, computed once.
+
+    ``flatten_debit`` covers the flatten + prediction validation every
+    variant previously paid inside its own fit; ``partition_debit``
+    additionally covers the by-class index arrays + per-class AT views the
+    three per-class variants each rebuilt. ``debit_for`` returns the share
+    a variant's setup record owes (CoverageWorker's time-debit scheme).
+    """
+
+    def __init__(self, train_ats, train_pred):
+        flat_timer = Timer()
+        with flat_timer:
+            self.flat = _flatten_layers(train_ats)
+            self.pred = _class_predictions(_flatten_predictions(train_pred))
+        part_timer = Timer()
+        with part_timer:
+            self.class_ids = np.unique(self.pred)
+            self.class_views: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+            for c in self.class_ids:
+                mask = self.pred == c
+                self.class_views[int(c)] = (self.flat[mask], self.pred[mask])
+        self.flatten_debit = flat_timer.get()
+        self.partition_debit = part_timer.get()
+
+    def debit_for(self, sa_name: str) -> float:
+        """Shared-prep seconds attributable to ``sa_name``'s setup record."""
+        if sa_name in BY_CLASS_MODAL:
+            return self.flatten_debit + self.partition_debit
+        return self.flatten_debit
+
+
+class VariantFitter:
+    """Builds every registry variant from one ``SharedTrainPrep``.
+
+    Per-modal constructors (and the KMeans candidate-k fits, when the
+    resolved cluster backend is sklearn) fan out over ``pool``; everything
+    is seeded, so the result is bit-identical to the serial reference path
+    (pinned by tests/test_sa_prep.py).
+    """
+
+    def __init__(self, prep: SharedTrainPrep, pool: Optional[FitPool] = None):
+        self.prep = prep
+        self.pool = pool or FitPool(1)
+
+    def _poolable(self, kind: str) -> bool:
+        # lsa/mdsa are pure host numpy/scipy; mlsa3 and the KMeans candidate
+        # fits only when the resolved backend is sklearn — pooling the jnp
+        # backend would move device fits onto worker CPUs and silently
+        # change numerics vs the serial device path.
+        if kind in ("lsa", "mdsa"):
+            return True
+        return resolved_cluster_backend() == "sklearn"
+
+    def _fit_modals(self, kind: str, partitions) -> Dict[int, object]:
+        tasks = [(int(m), kind, acts, preds) for m, (acts, preds) in partitions]
+        mapper = self.pool.map if self._poolable(kind) else lambda f, t: [f(x) for x in t]
+        return dict(mapper(_fit_modal_task, tasks))
+
+    def build(self, sa_name: str):
+        """Fit one registry variant; returns the fitted scorer (any
+        ``dsa_badge_size`` override is the caller's concern — it is device
+        chunking, not fitted state)."""
+        prep = self.prep
+        if sa_name == "dsa":
+            return DSA(prep.flat, prep.pred, subsampling=0.3)
+        if sa_name in BY_CLASS_MODAL:
+            modal_sa = self._fit_modals(
+                BY_CLASS_MODAL[sa_name],
+                ((c, prep.class_views[int(c)]) for c in prep.class_ids),
+            )
+            return MultiModalSA(
+                discriminator=_by_class_discriminator, modal_sa=modal_sa
+            )
+        if sa_name == "pc-mmdsa":
+            kmeans_map = self.pool.map if self._poolable("kmeans") else None
+            discriminator = _KmeansDiscriminator(
+                training_data=prep.flat,
+                potential_k=range(2, 6),
+                subsampling=0.3,
+                fit_map=kmeans_map,
+            )
+            modal_indexes = discriminator(prep.flat, prep.pred)
+            modal_sa = self._fit_modals(
+                "mdsa",
+                (
+                    (m, (prep.flat[modal_indexes == m], prep.pred[modal_indexes == m]))
+                    for m in np.unique(modal_indexes)
+                ),
+            )
+            return MultiModalSA(discriminator=discriminator, modal_sa=modal_sa)
+        raise KeyError(f"unknown SA variant {sa_name!r}")
+
+
+def train_fingerprint(params, training_dataset, sa_layers: Sequence) -> str:
+    """Content fingerprint of one (model, train set, tap config) triple.
+
+    sha256 over the parameter leaves, the raw training array bytes, the SA
+    tap layers, the resolved cluster backend (it changes fitted estimators)
+    and the cache format version. Deliberately does NOT require a forward
+    pass: a fully-warm cache must be able to skip train-AT collection
+    entirely.
+    """
+    import jax
+
+    h = hashlib.sha256()
+    h.update(CACHE_FORMAT_VERSION.encode())
+    h.update(repr(list(sa_layers)).encode())
+    h.update(resolved_cluster_backend().encode())
+    for leaf in jax.tree_util.tree_leaves(params):
+        arr = np.asarray(leaf)
+        h.update(str(arr.shape).encode() + str(arr.dtype).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    data = np.asarray(training_dataset)
+    h.update(str(data.shape).encode() + str(data.dtype).encode())
+    h.update(np.ascontiguousarray(data).tobytes())
+    return h.hexdigest()
+
+
+class SAFitCache:
+    """Disk-backed fitted-scorer cache for the five SA registry variants.
+
+    One pickle per (case study, model id, fingerprint, variant) under
+    ``TIP_SA_CACHE_DIR`` (default ``$TIP_ASSETS/sa_fit_cache``; ``off``
+    disables, as does constructing with ``root=None``). Writes are atomic
+    (tmp + rename, unique per pid) so concurrent scheduler workers can
+    share one cache dir; loads verify the stored meta and treat ANY
+    read/unpickle failure as a miss (refit overwrites the bad entry) — a
+    corrupt cache can cost time, never correctness.
+    """
+
+    def __init__(self, root: str, case_study: str, model_ref: str, fingerprint: str):
+        self.root = root
+        self.case_study = case_study
+        self.model_ref = model_ref
+        self.fingerprint = fingerprint
+
+    @classmethod
+    def from_env(
+        cls, case_study: Optional[str], model_id, params, training_dataset, sa_layers
+    ) -> Optional["SAFitCache"]:
+        """Cache handle per ``TIP_SA_CACHE_DIR`` policy, or None when off."""
+        raw = os.environ.get("TIP_SA_CACHE_DIR", "").strip()
+        if raw.lower() in ("off", "0"):
+            return None
+        if not raw:
+            from simple_tip_tpu.config import output_folder
+
+            raw = os.path.join(output_folder(), "sa_fit_cache")
+        fp = train_fingerprint(params, training_dataset, sa_layers)
+        return cls(
+            root=raw,
+            case_study=case_study or "default",
+            model_ref="na" if model_id is None else str(model_id),
+            fingerprint=fp,
+        )
+
+    def _path(self, sa_name: str) -> str:
+        return os.path.join(
+            self.root,
+            f"{self.case_study}_{self.model_ref}_{self.fingerprint[:16]}"
+            f"_{sa_name}.pkl",
+        )
+
+    def describe(self, sa_name: str) -> str:
+        """Human-readable entry label for cache-hit/miss log lines."""
+        return self._path(sa_name)
+
+    def load(self, sa_name: str):
+        """The cached fitted scorer, or None on miss/stale/corrupt entries."""
+        path = self._path(sa_name)
+        try:
+            with open(path, "rb") as f:
+                entry = pickle.load(f)
+            meta = entry["meta"]
+            if (
+                meta["version"] != CACHE_FORMAT_VERSION
+                or meta["variant"] != sa_name
+                or meta["fingerprint"] != self.fingerprint
+            ):
+                logger.info("sa-fit cache STALE for %s (%s)", sa_name, path)
+                return None
+            return entry["scorer"]
+        except FileNotFoundError:
+            return None
+        except Exception as e:  # noqa: BLE001 — any corrupt entry degrades to refit
+            logger.warning(
+                "sa-fit cache entry corrupt for %s (%s: %r); refitting",
+                sa_name,
+                path,
+                e,
+            )
+            return None
+
+    def store(self, sa_name: str, scorer) -> None:
+        """Persist one fitted scorer (atomic; failures warn, never raise)."""
+        path = self._path(sa_name)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            entry = {
+                "meta": {
+                    "version": CACHE_FORMAT_VERSION,
+                    "variant": sa_name,
+                    "fingerprint": self.fingerprint,
+                    "case_study": self.case_study,
+                    "model_ref": self.model_ref,
+                },
+                "scorer": scorer,
+            }
+            with open(tmp, "wb") as f:
+                pickle.dump(entry, f, protocol=4)
+            os.replace(tmp, path)
+            logger.info("sa-fit cache stored %s (%s)", sa_name, path)
+        except Exception as e:  # noqa: BLE001 — cache is an optimization only
+            logger.warning("sa-fit cache store failed for %s (%r)", sa_name, e)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass

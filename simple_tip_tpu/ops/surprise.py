@@ -42,21 +42,15 @@ logger = logging.getLogger(__name__)
 # ---------------------------------------------------------------------------
 
 
-def _cluster_backend():
-    """(KMeans, silhouette_score, GaussianMixture) from the configured backend.
+def resolved_cluster_backend() -> str:
+    """The concrete backend (``"sklearn"`` or ``"jax"``) that
+    ``TIP_CLUSTER_BACKEND`` resolves to on this host right now.
 
-    ``TIP_CLUSTER_BACKEND``: ``auto`` (default) picks sklearn's C
-    implementations on CPU hosts and the TPU-native jnp ones
-    (ops/cluster.py) when an accelerator backend is active; ``jax`` /
-    ``sklearn`` force one side. Rationale (measured, HOST_PHASE.json): the
-    jnp GMM's fixed-length vmapped EM restarts are built for the MXU —
-    on one CPU core they cost ~110 min of a 121-min paper-scale prio phase,
-    where sklearn's early-stopping C EM (what the reference itself runs,
-    reference: src/core/surprise.py:509) fits in minutes. Same policy as
-    the AL retrain path (device: vmapped ensemble; host: sequential).
+    Exposed so callers that must pin the choice across process boundaries
+    (the SA fit pool, engine/sa_prep.py — a spawned worker re-resolving
+    ``auto`` would import jax itself) and cache fingerprints (the fitted
+    estimators differ per backend) can record it explicitly.
     """
-    import os
-
     choice = os.environ.get("TIP_CLUSTER_BACKEND", "auto").strip().lower()
     if choice not in ("auto", "jax", "sklearn"):
         raise ValueError(
@@ -72,6 +66,33 @@ def _cluster_backend():
             # initialized the backend, so this does not first-touch a
             # potentially dead tunnel.
             choice = "sklearn" if jax.default_backend() == "cpu" else "jax"
+    return choice
+
+
+def _cluster_backend():
+    """(KMeans, silhouette_score, GaussianMixture) from the configured backend.
+
+    ``TIP_CLUSTER_BACKEND``: ``auto`` (default) picks sklearn's C
+    implementations on CPU hosts and the TPU-native jnp ones
+    (ops/cluster.py) when an accelerator backend is active; ``jax`` /
+    ``sklearn`` force one side. Rationale (measured, HOST_PHASE.json): the
+    jnp GMM's fixed-length vmapped EM restarts are built for the MXU —
+    on one CPU core they cost ~110 min of a 121-min paper-scale prio phase,
+    where sklearn's early-stopping C EM (what the reference itself runs,
+    reference: src/core/surprise.py:509) fits in minutes. Same policy as
+    the AL retrain path (device: vmapped ensemble; host: sequential).
+
+    Known exception to the "sklearn on CPU hosts" contract: with ``auto``,
+    the KMeans k-selection silhouette in ``_KmeansDiscriminator`` does NOT
+    use the sklearn function returned here — it uses the jnp f32
+    shared-distance pass (``ops/cluster.silhouette_scores_multi``), which
+    pays the label-independent O(n²·d) pairwise work once for all candidate
+    k instead of once per k. Only an EXPLICIT ``TIP_CLUSTER_BACKEND=sklearn``
+    gets sklearn's own f64 per-k silhouette — the "force one side" contract
+    outranks the speedup. Selection parity (same argmax, ties to the
+    smaller k) is pinned by tests/test_cluster.py.
+    """
+    choice = resolved_cluster_backend()
     if choice == "sklearn":
         from sklearn.cluster import KMeans
         from sklearn.metrics import silhouette_score
@@ -172,9 +193,28 @@ def _by_class_discriminator(
     return _class_predictions(predictions)
 
 
+def _fit_candidate_kmeans(task):
+    """Fit ONE candidate-k KMeans (runs in a fit-pool worker or inline).
+
+    ``task`` = (k, n_init, max_iter, seed, training_data); returns
+    (k, fitted KMeans, labels). Top-level so spawn can pickle it; the
+    worker re-resolves the cluster backend from its (parent-pinned) env.
+    """
+    k, n_init, max_iter, seed, training_data = task
+    KMeans, _, _ = _cluster_backend()
+    kmeans = KMeans(n_clusters=k, n_init=n_init, max_iter=max_iter, random_state=seed)
+    return k, kmeans, kmeans.fit_predict(training_data)
+
+
 class _KmeansDiscriminator:
     """Silhouette-scored KMeans over candidate k values
-    (reference: src/core/surprise.py:102-133)."""
+    (reference: src/core/surprise.py:102-133).
+
+    ``fit_map`` optionally fans the independent candidate-k fits over a
+    caller-supplied order-preserving parallel map (the SA fit pool,
+    engine/sa_prep.py); ``None`` keeps the serial in-process loop. Either
+    way each fit is seeded, so the selected clusterer is identical.
+    """
 
     def __init__(
         self,
@@ -185,8 +225,9 @@ class _KmeansDiscriminator:
         n_init: int = 10,
         max_iter: int = 300,
         seed: Optional[int] = 0,
+        fit_map=None,
     ):
-        KMeans, backend_silhouette, _ = _cluster_backend()
+        _, backend_silhouette, _ = _cluster_backend()
         from simple_tip_tpu.ops.cluster import silhouette_scores_multi
 
         training_data = _flatten_layers(training_data)
@@ -202,12 +243,13 @@ class _KmeansDiscriminator:
         # tests/test_cluster.py. An EXPLICIT TIP_CLUSTER_BACKEND=sklearn
         # keeps sklearn's own f64 silhouette per k — the "force one side"
         # contract (_cluster_backend docstring) outranks the speedup.
-        fitted = []
-        for i in potential_k:
-            kmeans = KMeans(
-                n_clusters=i, n_init=n_init, max_iter=max_iter, random_state=seed
-            )
-            fitted.append((i, kmeans, kmeans.fit_predict(training_data)))
+        tasks = [
+            (i, n_init, max_iter, seed, training_data) for i in potential_k
+        ]
+        if fit_map is None:
+            fitted = [_fit_candidate_kmeans(t) for t in tasks]
+        else:
+            fitted = list(fit_map(_fit_candidate_kmeans, tasks))
         forced = os.environ.get("TIP_CLUSTER_BACKEND", "auto").strip().lower()
         if forced == "sklearn":
             scores = [
@@ -676,6 +718,15 @@ class DSA(SA):
         self._device_state = None
         self._pallas_backend = None
         self.use_pallas: Optional[bool] = None  # None = auto-detect
+
+    def __getstate__(self):
+        """Pickle support (SA fit cache / fit pool, engine/sa_prep.py): the
+        jitted chunk closure and the pallas backend are process-local device
+        handles — dropped here and rebuilt lazily on the first score."""
+        state = self.__dict__.copy()
+        state["_device_state"] = None
+        state["_pallas_backend"] = None
+        return state
 
     def _prepare_device(self):
         import jax

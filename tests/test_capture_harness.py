@@ -183,12 +183,22 @@ def test_rc_reflects_observed_window_not_startup_probe(
     study = json.load(open(study_json))
     assert study["phases"]["training"]["0"]["platform"] == "axon"
 
-    # up at startup, but DOWN by the first per-run probe of the remaining
-    # tunnel-bound phase: window closed mid-capture -> rc 2, not 0
+    # up at startup, but DOWN by the first per-run probe — NO device work
+    # was actually observed, so this is not a window at all: rc 3, not 2
+    # (ADVICE r5: rc 2 made the watcher fire every one-shot device capture
+    # against the closed window, burning ~90 s probe timeouts per cycle)
     os.remove(study_json)
     probes2 = iter(["axon", "down", "down"])
     monkeypatch.setattr(
         harness, "_probe_platform", lambda timeout_s=90.0: next(probes2))
+    assert harness.main() == 3
+
+    # up at startup, device work observed (training run 0 on the chip),
+    # then DOWN mid-study: a real window closed mid-capture -> rc 2
+    os.remove(study_json)
+    probes3 = iter(["axon", "axon", "down", "down"])
+    monkeypatch.setattr(
+        harness, "_probe_platform", lambda timeout_s=90.0: next(probes3))
     assert harness.main() == 2
 
 

@@ -1,0 +1,69 @@
+"""Synthetic-hardness provenance pin of the mini-study asset bus
+(scripts/mini_env.py, ADVICE r5).
+
+A mini-study assets dir is generated at ONE hardness; checkpoints trained
+on that generation must never be silently evaluated against data from
+another (``cs.train()`` skips existing checkpoints, loaders regenerate from
+the current env). The pin file written on first generation plus the loud
+bootstrap-time verification close that hole.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts.mini_env import verify_hardness_pin  # noqa: E402
+
+from simple_tip_tpu.data.synthetic import DEFAULT_HARDNESS  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_env_hardness(monkeypatch):
+    monkeypatch.delenv("TIP_SYNTH_HARDNESS", raising=False)
+
+
+def test_fresh_assets_dir_writes_pin(tmp_path):
+    assets = str(tmp_path / "assets")
+    assert verify_hardness_pin(assets) == DEFAULT_HARDNESS
+    with open(os.path.join(assets, "synth_hardness.json")) as f:
+        assert json.load(f)["synth_hardness"] == DEFAULT_HARDNESS
+
+
+def test_matching_pin_passes_and_mismatch_fails_loudly(tmp_path, monkeypatch):
+    assets = str(tmp_path / "assets")
+    verify_hardness_pin(assets)
+    # same env -> fine (idempotent re-entry, e.g. a resumed study)
+    assert verify_hardness_pin(assets) == DEFAULT_HARDNESS
+    # a different generation hardness must abort BEFORE any loader runs
+    monkeypatch.setenv("TIP_SYNTH_HARDNESS", "0")
+    with pytest.raises(SystemExit, match="mismatch"):
+        verify_hardness_pin(assets)
+
+
+def test_pre_hardness_bus_with_checkpoints_fails_loudly(tmp_path):
+    """An assets dir with checkpoints but no pin (pre-pin generations, e.g.
+    the r04 bus) must refuse to run rather than guess its hardness."""
+    assets = str(tmp_path / "assets")
+    os.makedirs(os.path.join(assets, "models"))
+    with pytest.raises(SystemExit, match="no synth_hardness.json"):
+        verify_hardness_pin(assets)
+
+
+def test_pre_hardness_bus_adopts_explicit_env_pin(tmp_path, monkeypatch):
+    """An EXPLICIT env value asserts the bus's generation hardness and
+    becomes the adopted pin (mirrors the study-JSON pin semantics in
+    scripts/capture_tpu_evidence.py)."""
+    assets = str(tmp_path / "assets")
+    os.makedirs(os.path.join(assets, "models"))
+    monkeypatch.setenv("TIP_SYNTH_HARDNESS", "0")
+    assert verify_hardness_pin(assets) == 0.0
+    with open(os.path.join(assets, "synth_hardness.json")) as f:
+        assert json.load(f)["synth_hardness"] == 0.0
+    # and from then on a default-hardness invocation is rejected
+    monkeypatch.delenv("TIP_SYNTH_HARDNESS")
+    with pytest.raises(SystemExit, match="mismatch"):
+        verify_hardness_pin(assets)

@@ -1,0 +1,332 @@
+"""Parity + cache-semantics contract of the SA fit layer (engine/sa_prep.py).
+
+The tentpole claim is that the shared-prep / process-pool / pipelined /
+disk-cached fit paths are PURE optimizations: seeded scores and CAM orders
+must be byte-identical to the serial reference path for all five registry
+variants, and the cache must be correct under hits, stale fingerprints and
+corrupt entries (hit skips the fit AND the train-AT forward pass; stale
+fingerprint misses; corruption degrades to a refit, never to wrong data).
+"""
+
+import logging
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from simple_tip_tpu.engine import sa_prep
+from simple_tip_tpu.engine.sa_prep import (
+    FitPool,
+    SAFitCache,
+    SharedTrainPrep,
+    VariantFitter,
+    train_fingerprint,
+)
+from simple_tip_tpu.engine.surprise_handler import SA_VARIANTS, SurpriseHandler
+
+N_CLASSES = 4
+
+
+def _fake_traces(self, dataset):
+    """Deterministic stand-in for the tapped forward pass: the dataset IS
+    the activation matrix; predictions derive from its row sums."""
+    ats = [np.asarray(dataset, dtype=np.float32)]
+    preds = (np.abs(np.asarray(dataset)).sum(axis=1) * 7).astype(np.int64) % N_CLASSES
+    return ats, preds
+
+
+@pytest.fixture
+def sa_data():
+    """(train_x, datasets) shaped so every class has enough samples for
+    every variant (3-component MLSA, KMeans k in 2..5 at 30% subsampling)."""
+    rng = np.random.default_rng(7)
+    train_x = rng.normal(size=(360, 12)).astype(np.float32)
+    datasets = {
+        "nominal": rng.normal(size=(50, 12)).astype(np.float32),
+        "ood": (rng.normal(size=(40, 12)) * 1.5 + 0.3).astype(np.float32),
+    }
+    return train_x, datasets
+
+
+@pytest.fixture
+def handler_factory(sa_data, monkeypatch):
+    """Builds SurpriseHandlers over the synthetic traces with env control."""
+    monkeypatch.setattr(SurpriseHandler, "_traces", _fake_traces)
+    train_x, datasets = sa_data
+
+    def make(train=None, params=None, case_study="satest", model_id=0):
+        return SurpriseHandler(
+            model_def=None,
+            params={"w": np.arange(6.0)} if params is None else params,
+            sa_layers=[0],
+            training_dataset=train_x if train is None else train,
+            case_study=case_study,
+            model_id=model_id,
+        )
+
+    return make, datasets
+
+
+def _assert_identical(res_a, res_b):
+    assert sorted(res_a) == sorted(res_b) == sorted(SA_VARIANTS)
+    for sa_name in res_a:
+        for ds_name in res_a[sa_name]:
+            scores_a, cam_a, _ = res_a[sa_name][ds_name]
+            scores_b, cam_b, _ = res_b[sa_name][ds_name]
+            np.testing.assert_array_equal(
+                scores_a, scores_b, err_msg=f"{sa_name}/{ds_name} scores"
+            )
+            np.testing.assert_array_equal(
+                cam_a, cam_b, err_msg=f"{sa_name}/{ds_name} CAM order"
+            )
+
+
+@pytest.fixture
+def serial_reference(sa_data, monkeypatch):
+    """Reference results through the ORIGINAL serial registry lambdas (no
+    shared prep, no pool, no cache, no pipeline)."""
+    monkeypatch.setattr(SurpriseHandler, "_traces", _fake_traces)
+    train_x, datasets = sa_data
+    train_ats, train_pred = _fake_traces(None, train_x)
+    results = {}
+    for sa_name, build in SA_VARIANTS.items():
+        scorer = build(train_ats, train_pred)
+        per_ds = {}
+        for ds_name, ds in datasets.items():
+            ats, preds = _fake_traces(None, ds)
+            scores = scorer(ats, preds)
+            from simple_tip_tpu.engine.surprise_handler import _sc_cam_order
+
+            per_ds[ds_name] = (scores, _sc_cam_order(scores), [0.0, 0.0, 0.0, 0.0])
+        results[sa_name] = per_ds
+    return results
+
+
+def test_shared_prep_partition_matches_masks(sa_data):
+    """The once-computed per-class views equal the per-variant boolean-mask
+    partitions the serial path rebuilds."""
+    train_x, _ = sa_data
+    ats, preds = _fake_traces(None, train_x)
+    prep = SharedTrainPrep(ats, preds)
+    flat = np.asarray(ats[0])
+    assert np.array_equal(prep.flat, flat)
+    for c in prep.class_ids:
+        acts, pred_view = prep.class_views[int(c)]
+        np.testing.assert_array_equal(acts, flat[preds == c])
+        np.testing.assert_array_equal(pred_view, preds[preds == c])
+    # by-class variants owe the partition debit on top of the flatten debit
+    assert prep.debit_for("pc-lsa") >= prep.debit_for("dsa") >= 0.0
+
+
+@pytest.mark.parametrize("pool_n", [1, 2])
+def test_fitter_matches_serial_registry_for_all_variants(
+    sa_data, serial_reference, pool_n
+):
+    """Shared-prep fits (serial and pool=2) are byte-identical to the
+    registry lambdas for every variant on every dataset."""
+    train_x, datasets = sa_data
+    ats, preds = _fake_traces(None, train_x)
+    fitter = VariantFitter(SharedTrainPrep(ats, preds), FitPool(pool_n))
+    try:
+        for sa_name in SA_VARIANTS:
+            scorer = fitter.build(sa_name)
+            for ds_name, ds in datasets.items():
+                t_ats, t_preds = _fake_traces(None, ds)
+                np.testing.assert_array_equal(
+                    scorer(t_ats, t_preds),
+                    serial_reference[sa_name][ds_name][0],
+                    err_msg=f"{sa_name}/{ds_name} (pool={pool_n})",
+                )
+    finally:
+        fitter.pool.close()
+
+
+@pytest.mark.parametrize(
+    "env",
+    [
+        {"TIP_SA_PIPELINE": "0", "TIP_SA_POOL": "1"},
+        {"TIP_SA_PIPELINE": "1", "TIP_SA_POOL": "1"},
+        {"TIP_SA_PIPELINE": "1", "TIP_SA_POOL": "2"},
+    ],
+)
+def test_evaluate_all_matches_serial_reference(
+    handler_factory, serial_reference, monkeypatch, env
+):
+    """The full engine path — pipelined and/or pooled — reproduces the
+    serial reference byte-for-byte (scores AND CAM orders)."""
+    monkeypatch.setenv("TIP_SA_CACHE_DIR", "off")
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    make, datasets = handler_factory
+    _assert_identical(make().evaluate_all(datasets), serial_reference)
+
+
+def test_cache_hit_skips_fit_and_train_forward(
+    handler_factory, serial_reference, tmp_path, monkeypatch, caplog
+):
+    """Second handler over the same (params, train set, layers) loads every
+    scorer from disk: byte-identical results, no train-AT forward pass, no
+    VariantFitter.build call, logged cache hits."""
+    monkeypatch.setenv("TIP_SA_CACHE_DIR", str(tmp_path / "sa_cache"))
+    monkeypatch.setenv("TIP_SA_PIPELINE", "0")
+    monkeypatch.setenv("TIP_SA_POOL", "1")
+    make, datasets = handler_factory
+
+    cold = make().evaluate_all(datasets)
+    _assert_identical(cold, serial_reference)
+    entries = sorted(os.listdir(tmp_path / "sa_cache"))
+    assert len(entries) == len(SA_VARIANTS)
+
+    def _no_fit(self, sa_name):
+        raise AssertionError(f"cache hit expected, but {sa_name} was refit")
+
+    monkeypatch.setattr(VariantFitter, "build", _no_fit)
+    warm_handler = make()
+    with caplog.at_level(logging.INFO, logger="simple_tip_tpu.engine.surprise_handler"):
+        warm = warm_handler.evaluate_all(datasets)
+    _assert_identical(warm, serial_reference)
+    assert warm_handler.train_ats is None, "warm cache must skip the train forward"
+    hits = [r for r in caplog.records if "cache HIT" in r.getMessage()]
+    assert len(hits) == len(SA_VARIANTS)
+
+
+def test_stale_fingerprint_misses(handler_factory, sa_data, tmp_path, monkeypatch):
+    """A changed train set (or params) changes the fingerprint: the cache
+    must MISS and refit rather than serve the other generation's scorers."""
+    monkeypatch.setenv("TIP_SA_CACHE_DIR", str(tmp_path / "sa_cache"))
+    monkeypatch.setenv("TIP_SA_PIPELINE", "0")
+    monkeypatch.setenv("TIP_SA_POOL", "1")
+    make, datasets = handler_factory
+    make().evaluate_all(datasets)
+
+    train_x, _ = sa_data
+    other = make(train=train_x + 0.25)
+    other.evaluate_all(datasets)
+    assert other.train_ats is not None, "stale fingerprint must trigger a refit"
+    # both generations coexist: 5 entries per fingerprint
+    assert len(os.listdir(tmp_path / "sa_cache")) == 2 * len(SA_VARIANTS)
+
+    fp_a = train_fingerprint({"w": np.arange(6.0)}, train_x, [0])
+    fp_b = train_fingerprint({"w": np.arange(6.0)}, train_x + 0.25, [0])
+    assert fp_a != fp_b
+
+
+def test_corrupt_cache_entry_falls_back_to_refit(
+    handler_factory, serial_reference, tmp_path, monkeypatch, caplog
+):
+    """Truncated/garbage entries must degrade to a refit (with a warning),
+    and the refit must overwrite them with good entries."""
+    cache_dir = tmp_path / "sa_cache"
+    monkeypatch.setenv("TIP_SA_CACHE_DIR", str(cache_dir))
+    monkeypatch.setenv("TIP_SA_PIPELINE", "0")
+    monkeypatch.setenv("TIP_SA_POOL", "1")
+    make, datasets = handler_factory
+    make().evaluate_all(datasets)
+    for name in os.listdir(cache_dir):
+        with open(cache_dir / name, "wb") as f:
+            f.write(b"\x80\x04 this is not a pickle")
+    with caplog.at_level(logging.WARNING, logger="simple_tip_tpu.engine.sa_prep"):
+        refit = make().evaluate_all(datasets)
+    _assert_identical(refit, serial_reference)
+    assert any("corrupt" in r.getMessage() for r in caplog.records)
+    # the refit overwrote the garbage: a third run loads cleanly again
+    third_handler = make()
+    _assert_identical(third_handler.evaluate_all(datasets), serial_reference)
+    assert third_handler.train_ats is None
+
+
+def test_wrong_variant_entry_is_stale_not_wrong(
+    handler_factory, tmp_path, monkeypatch
+):
+    """An entry whose stored meta does not match the requested variant (e.g.
+    a renamed file) is treated as a miss, never returned."""
+    cache_dir = tmp_path / "sa_cache"
+    monkeypatch.setenv("TIP_SA_CACHE_DIR", str(cache_dir))
+    monkeypatch.setenv("TIP_SA_PIPELINE", "0")
+    monkeypatch.setenv("TIP_SA_POOL", "1")
+    make, datasets = handler_factory
+    handler = make()
+    handler.evaluate_all(datasets)
+    cache = handler._ensure_cache()
+    # graft dsa's entry onto pc-lsa's path
+    with open(cache._path("dsa"), "rb") as f:
+        entry = pickle.load(f)
+    with open(cache._path("pc-lsa"), "wb") as f:
+        pickle.dump(entry, f)
+    fresh = make()
+    assert fresh._ensure_cache().load("pc-lsa") is None
+
+
+def test_dsa_badge_size_applies_on_cache_hit(handler_factory, tmp_path, monkeypatch):
+    """The device chunk-size override is not fitted state: it must apply to
+    cached scorers exactly as to fresh ones."""
+    monkeypatch.setenv("TIP_SA_CACHE_DIR", str(tmp_path / "sa_cache"))
+    monkeypatch.setenv("TIP_SA_PIPELINE", "0")
+    monkeypatch.setenv("TIP_SA_POOL", "1")
+    make, datasets = handler_factory
+    make().evaluate_all(datasets, dsa_badge_size=77)
+    _, cached_dsa, _ = make()._prepare_one("dsa", 33)
+    assert cached_dsa.badge_size == 33
+
+
+def test_fit_pool_broken_pool_degrades_to_serial(monkeypatch, caplog):
+    """A pool-level failure must fall back to correct in-process fits."""
+
+    class _Broken:
+        def map(self, fn, tasks):
+            raise RuntimeError("worker OOM-killed")
+
+    pool = FitPool(2)
+    monkeypatch.setattr(pool, "_ensure", lambda: _Broken())
+    with caplog.at_level(logging.WARNING, logger="simple_tip_tpu.engine.sa_prep"):
+        out = pool.map(lambda t: t * 2, [1, 2, 3])
+    assert out == [2, 4, 6]
+    assert any("refitting serially" in r.getMessage() for r in caplog.records)
+
+
+def test_pool_size_knob(monkeypatch):
+    """TIP_SA_POOL parsing: auto (core-derived), explicit int, junk raises."""
+    monkeypatch.setenv("TIP_SA_POOL", "3")
+    assert sa_prep.pool_size() == 3
+    monkeypatch.setenv("TIP_SA_POOL", "auto")
+    monkeypatch.setattr(os, "cpu_count", lambda: 1)
+    assert sa_prep.pool_size() == 1
+    monkeypatch.setattr(os, "cpu_count", lambda: 16)
+    assert sa_prep.pool_size() == 8
+    monkeypatch.setenv("TIP_SA_POOL", "many")
+    with pytest.raises(ValueError):
+        sa_prep.pool_size()
+
+
+def test_pipeline_knob(monkeypatch):
+    """TIP_SA_PIPELINE parsing: default on, 0/off disables, junk raises."""
+    monkeypatch.delenv("TIP_SA_PIPELINE", raising=False)
+    assert sa_prep.pipeline_enabled()
+    monkeypatch.setenv("TIP_SA_PIPELINE", "0")
+    assert not sa_prep.pipeline_enabled()
+    monkeypatch.setenv("TIP_SA_PIPELINE", "maybe")
+    with pytest.raises(ValueError):
+        sa_prep.pipeline_enabled()
+
+
+def test_cache_fingerprint_covers_cluster_backend(sa_data, monkeypatch):
+    """Fitted estimators differ per cluster backend, so the fingerprint
+    must: sklearn- and jax-resolved fits may never cross-hit."""
+    train_x, _ = sa_data
+    monkeypatch.setenv("TIP_CLUSTER_BACKEND", "sklearn")
+    fp_sklearn = train_fingerprint({"w": np.arange(3.0)}, train_x, [0])
+    monkeypatch.setenv("TIP_CLUSTER_BACKEND", "jax")
+    fp_jax = train_fingerprint({"w": np.arange(3.0)}, train_x, [0])
+    assert fp_sklearn != fp_jax
+
+
+def test_cache_off_knob(handler_factory, monkeypatch):
+    """TIP_SA_CACHE_DIR=off disables persistence entirely."""
+    monkeypatch.setenv("TIP_SA_CACHE_DIR", "off")
+    make, _ = handler_factory
+    assert make()._ensure_cache() is None
+    assert (
+        SAFitCache.from_env("cs", 0, {"w": np.arange(2.0)}, np.zeros((2, 2)), [0])
+        is None
+    )
