@@ -36,7 +36,10 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...},
 including a ``sa_fit_seconds`` companion (five-variant surprise-adequacy
 fit wall-clock through the engine's shared-prep path at a small fixed
 shape — the prio phase's dominant host cost per HOST_PHASE.json;
-``TIP_BENCH_SA=0`` skips it).
+``TIP_BENCH_SA=0`` skips it), an ``obs_overhead_seconds`` companion
+(seconds per 1000 obs span cycles in the current TIP_OBS_DIR state, so the
+trajectory catches telemetry regressions) and the process's obs metrics
+snapshot (``obs_metrics``: compile counts, watchdog probe outcomes, ...).
 """
 
 import json
@@ -91,10 +94,12 @@ def _child_measure() -> None:
     import jax
     import jax.numpy as jnp
 
+    from simple_tip_tpu import obs
     from simple_tip_tpu.config import enable_compilation_cache
     from simple_tip_tpu.utils.device_watchdog import ensure_responsive_backend
 
     enable_compilation_cache()
+    obs.install_jax_hooks()
     platform = ensure_responsive_backend(
         timeout_s=float(os.environ.get("TIP_BENCH_PROBE_TIMEOUT_S", "75"))
     )
@@ -253,6 +258,19 @@ def _child_measure() -> None:
         except Exception as e:  # noqa: BLE001 — record, never fail the bench
             sa_fit_info = {"error": repr(e)[:300]}
 
+    # Telemetry-overhead companion: seconds per 1000 span enter/exit cycles
+    # in the CURRENT obs state (normally disabled — the no-op path the
+    # pipeline pays everywhere when TIP_OBS_DIR is unset). The trajectory
+    # reads this across rounds to catch telemetry regressions; the pinned
+    # absolute bound lives in tests/test_obs.py.
+    obs_reps = 1000 if obs.enabled() else 10_000
+    t0 = time.perf_counter()
+    for _ in range(obs_reps):
+        with obs.span("bench.overhead_probe"):
+            pass
+    obs_overhead = (time.perf_counter() - t0) * 1000.0 / obs_reps
+    obs.record_device_memory()
+
     # MFU accounting (round-3 verdict, missing #1): analytic conv/matmul
     # FLOPs of the scored program per input, achieved FLOP/s at the
     # measured rate, divided by the chip's nominal peak (bf16 MXU for
@@ -290,6 +308,9 @@ def _child_measure() -> None:
                     else {}
                 ),
                 "degraded": bool(on_cpu),
+                "obs_overhead_seconds": round(obs_overhead, 6),
+                "obs_enabled": obs.enabled(),
+                "obs_metrics": obs.metrics_snapshot(),
                 "flops_per_input": flops_per_input,
                 "achieved_flops_per_sec": round(achieved, 1),
                 "mfu": round(mfu_frac, 5),

@@ -15,3 +15,7 @@ cd "$(dirname "$0")/.."
 python -m compileall -q simple_tip_tpu scripts tests
 python -m simple_tip_tpu.analysis simple_tip_tpu scripts tests \
   --format "${TIPLINT_FORMAT:-text}"
+# Obs CLI self-check on the committed fixture trace: the run-inspection
+# tooling (simple_tip_tpu/obs — also stdlib-only) must keep parsing the
+# documented event schema, or post-hoc study inspection silently breaks.
+python -m simple_tip_tpu.obs check tests/fixtures/obs_trace

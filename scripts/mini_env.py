@@ -88,6 +88,16 @@ def bootstrap(assets: str = "/tmp/mini_study_assets") -> None:
     # plugin; and probing a dead tunnel would hang).
     jax.config.update("jax_platforms", "cpu")
 
+    # Library progress lines (training epochs, scheduler claims) are
+    # logger.* records now (tiplint bare-print); route them to stderr — and
+    # into the obs event stream when TIP_OBS_DIR is set — like the scheduler
+    # does for its workers. AFTER the TIP_ASSETS setdefault above: the
+    # bridge resolves an ``auto`` TIP_OBS_DIR, which must land under THIS
+    # bus's assets dir, not the cwd default.
+    from simple_tip_tpu import obs
+
+    obs.install_worker_logging()
+
 
 def class_coverage_preflight(cs, cs_name: str, run_ids) -> None:
     """Catch class-degenerate runs in seconds, not 20 min into test_prio.

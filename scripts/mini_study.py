@@ -58,6 +58,7 @@ def main() -> int:
 
     bootstrap(args.assets)
 
+    from simple_tip_tpu import obs
     from simple_tip_tpu.casestudies.mini import provide
 
     run_ids = list(range(args.runs))
@@ -68,14 +69,16 @@ def main() -> int:
         t0 = time.time()
         # group_size 1: XLA:CPU lowers vmapped (grouped) convs ~10x slower
         # than plain convs, so sequential-compiled-once wins on this host.
-        cs.train(run_ids, use_mesh=False, group_size=1)
+        with obs.span("training", cs=cs_name, runs=len(run_ids)):
+            cs.train(run_ids, use_mesh=False, group_size=1)
         timings[f"{cs_name}/training"] = round(time.time() - t0, 1)
         print(f"[{cs_name}] training done in {timings[f'{cs_name}/training']}s", flush=True)
 
         class_coverage_preflight(cs, cs_name, run_ids)
 
         t0 = time.time()
-        cs.run_prio_eval(run_ids, num_workers=args.workers)
+        with obs.span("test_prio", cs=cs_name, workers=args.workers):
+            cs.run_prio_eval(run_ids, num_workers=args.workers)
         timings[f"{cs_name}/test_prio"] = round(time.time() - t0, 1)
         print(f"[{cs_name}] test_prio done in {timings[f'{cs_name}/test_prio']}s", flush=True)
 
@@ -129,7 +132,8 @@ def main() -> int:
 
         al_runs = run_ids[: args.al_runs]
         t0 = time.time()
-        cs.run_active_learning_eval(al_runs, num_workers=args.workers)
+        with obs.span("active_learning", cs=cs_name, workers=args.workers):
+            cs.run_active_learning_eval(al_runs, num_workers=args.workers)
         timings[f"{cs_name}/active_learning"] = round(time.time() - t0, 1)
         print(
             f"[{cs_name}] active_learning ({len(al_runs)} runs) done in "
@@ -142,9 +146,17 @@ def main() -> int:
     from scripts.eval_export import export_results, hardness_env_label, run_all_evals
 
     t0 = time.time()
-    run_all_evals(CASE_STUDIES)
+    with obs.span("evaluation"):
+        run_all_evals(CASE_STUDIES)
     timings["evaluation"] = round(time.time() - t0, 1)
     print(f"evaluations done in {timings['evaluation']}s", flush=True)
+    obs.flush_metrics()
+    if obs.enabled():
+        print(
+            f"obs events in {obs.obs_dir()} — inspect with "
+            f"`python -m simple_tip_tpu.obs summary {obs.obs_dir()}`",
+            flush=True,
+        )
 
     manifest = {
         "case_studies": list(CASE_STUDIES),

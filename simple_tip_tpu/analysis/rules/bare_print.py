@@ -1,0 +1,71 @@
+"""Rule ``bare-print``: ``print()`` in library code bypasses every log sink.
+
+Library modules run inside spawned scheduler workers, fit-pool children and
+capture harnesses whose stdout is a pipe nobody reads (or worse, a pipe a
+JSON-line protocol owns — bench.py's one-line contract). A bare ``print``
+there is either lost or corrupts a machine-readable stream, and it bypasses
+the obs log bridge (simple_tip_tpu/obs/logbridge.py) that routes worker
+``logger.*`` records into the telemetry event stream. Use the module logger
+(or ``obs.event`` for structured telemetry) instead.
+
+Exempt by design:
+
+- the ``scripts/`` and ``tests/`` trees (their stdout IS the interface);
+- entry-point modules inside the package (``cli.py``, ``__main__.py``):
+  they are the package's script surface, argparse/stdout is their contract;
+- test modules (``test_*.py``, ``conftest.py``) wherever they live.
+
+Anything else needs an inline suppression with a justification.
+"""
+
+import ast
+import os
+from typing import Iterator, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+
+#: Analysis-root basenames whose whole tree is script/test surface.
+EXEMPT_ROOTS = ("scripts", "tests")
+
+#: Module basenames that are entry points (stdout is their contract).
+EXEMPT_BASENAMES = ("cli.py", "__main__.py", "conftest.py")
+
+
+def _exempt(module: ModuleInfo) -> bool:
+    """Whether ``module`` is script/test/entry-point surface."""
+    if os.path.basename(module.root) in EXEMPT_ROOTS:
+        return True
+    parts = module.relpath.split("/")
+    if any(p in EXEMPT_ROOTS for p in parts[:-1]):
+        return True
+    base = parts[-1]
+    return base in EXEMPT_BASENAMES or base.startswith("test_")
+
+
+@register
+class BarePrintRule(Rule):
+    """Flag ``print()`` calls in library (non-script, non-entry-point) code."""
+
+    name = "bare-print"
+    description = (
+        "print() in library code: lost in spawned workers, corrupts "
+        "JSON-line protocols; use the module logger or obs events "
+        "(scripts/tests/cli entry points exempt)"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Flag bare print calls outside the exempt surfaces."""
+        if _exempt(module):
+            return
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                yield "", node.lineno, (
+                    "print() in library code goes nowhere in spawned "
+                    "workers and corrupts JSON-line stdout protocols; use "
+                    "the module logger (routed to stderr + the obs stream "
+                    "by obs.install_worker_logging) or obs.event()"
+                )

@@ -262,4 +262,5 @@ if __name__ == "__main__":
     logging.basicConfig(level=logging.INFO)
     rep = prepare_all()
     for k, v in sorted(rep.items()):
+        # tiplint: disable=bare-print (__main__ report table; stdout is the interface)
         print(f"{k:12s} {v}")

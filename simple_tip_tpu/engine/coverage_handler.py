@@ -16,6 +16,7 @@ from typing import Callable, Dict, List, Tuple
 
 import numpy as np
 
+from simple_tip_tpu import obs
 from simple_tip_tpu.config import output_folder
 from simple_tip_tpu.engine.model_handler import BaseModel
 from simple_tip_tpu.ops.coverage import (
@@ -98,14 +99,15 @@ class CoverageWorker:
         self.temp_random = str(secrets.token_urlsafe(16))
 
         agg_stats = DeviceAggregateStatisticsCollector()
-        pred_timer = Timer(start=True)
-        for activations in base_model.walk_activations(
-            training_set, badge_size=PROFILE_BADGE_SIZE, device=True
-        ):
+        with obs.span("coverage.train_stats_pass", samples=len(training_set)):
+            pred_timer = Timer(start=True)
+            for activations in base_model.walk_activations(
+                training_set, badge_size=PROFILE_BADGE_SIZE, device=True
+            ):
+                pred_timer.stop()
+                agg_stats.track(activations)
+                pred_timer.start()
             pred_timer.stop()
-            agg_stats.track(activations)
-            pred_timer.start()
-        pred_timer.stop()
 
         mins, maxs, std = agg_stats.get()
 
@@ -159,14 +161,15 @@ class CoverageWorker:
         for metric_name, setup_time in self.setup_times.items():
             times[metric_name] = [setup_time, 0.0, 0.0]
 
-        self._prepare_profiles(test_dataset, ds_id=test_dataset_id, times=times)
+        with obs.span("coverage.profiles", ds=str(test_dataset_id)):
+            self._prepare_profiles(test_dataset, ds_id=test_dataset_id, times=times)
         for metric_id in self.metrics.keys():
             scores, packed, bit_len = self._load_prepared_profile(
                 metric_id=metric_id, ds_id=test_dataset_id, delete=True
             )
             all_scores[metric_id] = scores
 
-            timer = Timer()
+            timer = Timer(name="coverage.cam", metric=metric_id, ds=str(test_dataset_id))
             with timer:
                 cam_orders[metric_id] = list(
                     _cam_from_packed(scores, packed, bit_len)
@@ -197,6 +200,14 @@ class CoverageWorker:
         with timer:
             self.metrics[metric_id] = metric_supplier()
         self.setup_times[metric_id] = time_debit + timer.get()
+        # The shared-stats debit scheme made auditable: each metric's setup
+        # record = its own constructor time + its share of the one stats pass.
+        obs.event(
+            "coverage.debit",
+            metric=metric_id,
+            debit_s=round(time_debit, 6),
+            own_s=round(timer.get(), 6),
+        )
 
     def _timed_activation_walk(self, test_dataset: np.ndarray):
         # device=True: profiles are computed by the jnp kernels on-device and

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
+from simple_tip_tpu import obs
 from simple_tip_tpu.config import subdir
 from simple_tip_tpu.engine.coverage_handler import CoverageWorker
 from simple_tip_tpu.engine.model_handler import BaseModel
@@ -77,48 +78,52 @@ def evaluate(
     batch_size: int = 32,
 ) -> None:
     """Run the test-prioritization experiments for one trained model."""
-    _eval_fault_predictors(
-        case_study,
-        model_def,
-        params,
-        model_id,
-        nominal_test_dataset,
-        nominal_test_labels,
-        "nominal",
-        batch_size,
-    )
-    _eval_fault_predictors(
-        case_study,
-        model_def,
-        params,
-        model_id,
-        ood_test_dataset,
-        ood_test_labels,
-        "ood",
-        batch_size,
-    )
-    _eval_neuron_coverage(
-        case_study,
-        model_def,
-        params,
-        model_id,
-        nc_activation_layers,
-        nominal_test_dataset,
-        ood_test_dataset,
-        training_dataset,
-        batch_size,
-    )
-    _eval_surprise(
-        case_study,
-        model_def,
-        params,
-        model_id,
-        sa_activation_layers,
-        nominal_test_dataset,
-        ood_test_dataset,
-        training_dataset,
-        dsa_badge_size=dsa_badge_size,
-    )
+    with obs.span("prio.fault_predictors", model_id=model_id, ds="nominal"):
+        _eval_fault_predictors(
+            case_study,
+            model_def,
+            params,
+            model_id,
+            nominal_test_dataset,
+            nominal_test_labels,
+            "nominal",
+            batch_size,
+        )
+    with obs.span("prio.fault_predictors", model_id=model_id, ds="ood"):
+        _eval_fault_predictors(
+            case_study,
+            model_def,
+            params,
+            model_id,
+            ood_test_dataset,
+            ood_test_labels,
+            "ood",
+            batch_size,
+        )
+    with obs.span("prio.neuron_coverage", model_id=model_id):
+        _eval_neuron_coverage(
+            case_study,
+            model_def,
+            params,
+            model_id,
+            nc_activation_layers,
+            nominal_test_dataset,
+            ood_test_dataset,
+            training_dataset,
+            batch_size,
+        )
+    with obs.span("prio.surprise", model_id=model_id):
+        _eval_surprise(
+            case_study,
+            model_def,
+            params,
+            model_id,
+            sa_activation_layers,
+            nominal_test_dataset,
+            ood_test_dataset,
+            training_dataset,
+            dsa_badge_size=dsa_badge_size,
+        )
 
 
 def _eval_surprise(

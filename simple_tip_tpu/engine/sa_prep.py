@@ -42,6 +42,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from simple_tip_tpu import obs
 from simple_tip_tpu.ops.surprise import (
     DSA,
     LSA,
@@ -367,9 +368,15 @@ class SAFitCache:
                 or meta["fingerprint"] != self.fingerprint
             ):
                 logger.info("sa-fit cache STALE for %s (%s)", sa_name, path)
+                obs.counter("sa_fit_cache.stale").inc()
+                obs.event("sa_cache", variant=sa_name, outcome="stale")
                 return None
+            obs.counter("sa_fit_cache.hit").inc()
+            obs.event("sa_cache", variant=sa_name, outcome="hit")
             return entry["scorer"]
         except FileNotFoundError:
+            obs.counter("sa_fit_cache.miss").inc()
+            obs.event("sa_cache", variant=sa_name, outcome="miss")
             return None
         except Exception as e:  # noqa: BLE001 — any corrupt entry degrades to refit
             logger.warning(
@@ -378,6 +385,8 @@ class SAFitCache:
                 path,
                 e,
             )
+            obs.counter("sa_fit_cache.corrupt").inc()
+            obs.event("sa_cache", variant=sa_name, outcome="corrupt")
             return None
 
     def store(self, sa_name: str, scorer) -> None:
@@ -400,6 +409,7 @@ class SAFitCache:
                 pickle.dump(entry, f, protocol=4)
             os.replace(tmp, path)
             logger.info("sa-fit cache stored %s (%s)", sa_name, path)
+            obs.counter("sa_fit_cache.store").inc()
         except Exception as e:  # noqa: BLE001 — cache is an optimization only
             logger.warning("sa-fit cache store failed for %s (%r)", sa_name, e)
             try:

@@ -44,6 +44,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from simple_tip_tpu import obs
 from simple_tip_tpu.engine.model_handler import BaseModel
 from simple_tip_tpu.engine.sa_prep import (
     FitPool,
@@ -184,34 +185,38 @@ class SurpriseHandler:
         (train-AT collection + shared-prep debit + own fit); a cache hit
         records its load time (the work genuinely did not happen). The
         cache store itself is bus bookkeeping (like ``_persist``) and is
-        not part of the setup record.
+        not part of the setup record. The whole preparation is one obs
+        span (``sa_fit``) stamped with the variant and cache outcome.
         """
-        cache = self._ensure_cache()
-        if cache is not None:
-            load_timer = Timer()
-            with load_timer:
-                scorer = cache.load(sa_name)
-            if scorer is not None:
-                logger.info(
-                    "sa-fit cache HIT for %s (%s)", sa_name, cache.describe(sa_name)
-                )
-                if dsa_badge_size is not None and isinstance(scorer, DSA):
-                    scorer.badge_size = dsa_badge_size
-                return sa_name, scorer, load_timer.get()
-        fitter = self._ensure_fitter()
-        logger.info("fitting %s", sa_name)
-        with Timer() as fit_timer:
-            scorer = fitter.build(sa_name)
-        setup_s = (
-            self.train_at_timer.get()
-            + self._prep.debit_for(sa_name)
-            + fit_timer.get()
-        )
-        if cache is not None:
-            cache.store(sa_name, scorer)
-        if dsa_badge_size is not None and isinstance(scorer, DSA):
-            scorer.badge_size = dsa_badge_size
-        return sa_name, scorer, setup_s
+        with obs.span("sa_fit", variant=sa_name) as span:
+            cache = self._ensure_cache()
+            if cache is not None:
+                load_timer = Timer()
+                with load_timer:
+                    scorer = cache.load(sa_name)
+                if scorer is not None:
+                    logger.info(
+                        "sa-fit cache HIT for %s (%s)", sa_name, cache.describe(sa_name)
+                    )
+                    span.set(cached=True, setup_s=load_timer.get())
+                    if dsa_badge_size is not None and isinstance(scorer, DSA):
+                        scorer.badge_size = dsa_badge_size
+                    return sa_name, scorer, load_timer.get()
+            fitter = self._ensure_fitter()
+            logger.info("fitting %s", sa_name)
+            with Timer() as fit_timer:
+                scorer = fitter.build(sa_name)
+            setup_s = (
+                self.train_at_timer.get()
+                + self._prep.debit_for(sa_name)
+                + fit_timer.get()
+            )
+            span.set(cached=False, setup_s=setup_s)
+            if cache is not None:
+                cache.store(sa_name, scorer)
+            if dsa_badge_size is not None and isinstance(scorer, DSA):
+                scorer.badge_size = dsa_badge_size
+            return sa_name, scorer, setup_s
 
     def _prepared_scorers(
         self, dsa_badge_size: Optional[int]
@@ -258,9 +263,11 @@ class SurpriseHandler:
                 per_ds: Dict[str, DatasetResult] = {}
                 for ds_name, (ats, preds, pred_s) in traces.items():
                     logger.info("scoring %s on %s", sa_name, ds_name)
-                    with Timer() as quant_timer:
+                    # Named timers mirror the quant/cam segments into the
+                    # obs trace while keeping the reference timing record.
+                    with Timer(name="sa_score", variant=sa_name, ds=ds_name) as quant_timer:
                         scores = scorer(ats, preds)
-                    with Timer() as cam_timer:
+                    with Timer(name="sa_cam", variant=sa_name, ds=ds_name) as cam_timer:
                         order = _sc_cam_order(scores)
                     per_ds[ds_name] = (
                         scores,

@@ -17,6 +17,7 @@ function is pure in (params, opt_state, rng), so the ensemble layer can vmap
 it over a stacked parameter axis without modification.
 """
 
+import logging
 import math
 from dataclasses import dataclass
 from functools import lru_cache, partial
@@ -26,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass(frozen=True)
@@ -254,7 +257,9 @@ class Trainer:
                 params, opt_state, x_train, y_train, this_rng
             )
             if verbose:
-                print(f"epoch {epoch + 1}/{cfg.epochs} loss={float(loss):.4f}")
+                logger.info(
+                    "epoch %d/%d loss=%.4f", epoch + 1, cfg.epochs, float(loss)
+                )
         return params
 
 

@@ -1,0 +1,66 @@
+"""Unified telemetry: span tracing, metrics, and a run-inspection CLI.
+
+The pipeline's wall-clock story is decided by components that used to emit
+nothing an operator could correlate after the fact — the spawn-based run
+scheduler, the SA fit cache, the device watchdog, XLA recompiles. This
+subsystem gives every process one append-only JSONL event stream under
+``TIP_OBS_DIR`` (see ``tracer`` for the resolution rules), merged across the
+spawn boundary by worker stamping, and a CLI that renders a whole study as a
+per-phase summary table or one Perfetto/Chrome flame chart:
+
+- ``obs.span("fit", variant="dsa")`` / ``@obs.traced()``  nested spans
+- ``obs.event("scheduler.requeue", model_id=3)``          lifecycle events
+- ``obs.counter("sa_fit_cache.hit").inc()``               metrics registry
+- ``python -m simple_tip_tpu.obs summary|export|check``   run inspection
+
+Zero third-party dependencies (stdlib json), crash-safe (append-only JSONL;
+partial files still parse line-wise), and no-op when ``TIP_OBS_DIR`` is
+unset (overhead pinned by tests/test_obs.py). See README "Observability".
+"""
+
+from simple_tip_tpu.obs.logbridge import install_worker_logging
+from simple_tip_tpu.obs.metrics import (
+    counter,
+    gauge,
+    histogram,
+    install_jax_hooks,
+    record_device_memory,
+    snapshot as metrics_snapshot,
+    flush as flush_metrics,
+)
+from simple_tip_tpu.obs.tracer import (
+    enabled,
+    event,
+    obs_dir,
+    record_span,
+    reset,
+    span,
+    traced,
+)
+
+__all__ = [
+    "counter",
+    "enabled",
+    "event",
+    "flush_metrics",
+    "gauge",
+    "histogram",
+    "install_jax_hooks",
+    "install_worker_logging",
+    "metrics_snapshot",
+    "obs_dir",
+    "record_device_memory",
+    "record_span",
+    "reset",
+    "span",
+    "traced",
+]
+
+
+def reset_all() -> None:
+    """Full test-hook reset: tracer state, metrics registry, log bridge."""
+    from simple_tip_tpu.obs import logbridge, metrics, tracer
+
+    tracer.reset()
+    metrics.reset()
+    logbridge.reset()

@@ -1,0 +1,8 @@
+"""``python -m simple_tip_tpu.obs`` — the run-inspection CLI (see cli.py)."""
+
+import sys
+
+from simple_tip_tpu.obs.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,399 @@
+"""Run-inspection CLI: merge a run's JSONL streams, summarize, export.
+
+``python -m simple_tip_tpu.obs <command> <run-dir-or-files>``:
+
+- ``summary``  merge every ``events-*.jsonl`` in the run directory and print
+  a per-process, per-span-name (phase) and per-scheduled-run table plus the
+  summed metrics counters — the after-the-fact answer to "where did this
+  study's wall-clock go";
+- ``export``   write a Chrome/Perfetto ``trace_event`` JSON (``-o`` path;
+  load in https://ui.perfetto.dev or chrome://tracing) so a whole 100-run
+  study is one flame chart: one track group per process (worker-stamped),
+  spans as complete events, lifecycle events as instants, metrics flushes
+  as counter tracks;
+- ``check``    validate a trace (CI self-check): every line parses or is
+  counted as a torn tail, every event carries the schema's required keys,
+  every file opens with its ``meta`` stamp. Exit 1 on schema violations.
+
+Merging is tolerant by construction: files are read line-wise, unparsable
+lines (a crash's torn tail) are skipped and counted, and ordering is by the
+events' wall-clock ``ts`` — the streams share the host clock, which is
+exactly why spans record ``time.time`` starts next to their monotonic
+durations.
+
+Stdlib-only: this CLI is part of the tier-0 gate (no jax/numpy installed).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def iter_trace_files(target):
+    """Yield the JSONL files of ``target`` (a run dir, a file, or several)."""
+    targets = target if isinstance(target, (list, tuple)) else [target]
+    for t in targets:
+        if os.path.isdir(t):
+            names = sorted(
+                n
+                for n in os.listdir(t)
+                if n.startswith("events-") and n.endswith(".jsonl")
+            )
+            for n in names:
+                yield os.path.join(t, n)
+        else:
+            yield t
+
+
+def load_events(target):
+    """Merge ``target``'s streams into one ts-ordered event list.
+
+    Returns ``(events, files, bad_lines)``; every event is annotated with
+    its source file under ``_file``. Lines that fail to parse (torn crash
+    tails) are skipped and counted, never fatal.
+    """
+    events, files, bad = [], [], 0
+    for path in iter_trace_files(target):
+        files.append(path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, start=1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        bad += 1
+                        continue
+                    if not isinstance(rec, dict):
+                        bad += 1
+                        continue
+                    rec["_file"] = os.path.basename(path)
+                    # File-order position: spans are written on EXIT with
+                    # their (earlier) start ts, so ts order is NOT file
+                    # order — ``check`` needs the latter for the meta stamp.
+                    rec["_line"] = lineno
+                    events.append(rec)
+        except OSError as e:
+            print(f"obs: cannot read {path}: {e}", file=sys.stderr)
+    events.sort(key=lambda r: (r.get("ts") or 0, r.get("pid") or 0))
+    return events, files, bad
+
+
+def _processes(events):
+    """pid -> {worker, platform, first, last, spans, events, logs} rollup."""
+    procs = {}
+    for rec in events:
+        pid = rec.get("pid")
+        if pid is None:
+            continue
+        p = procs.setdefault(
+            pid,
+            {"worker": "", "platform": "", "first": None, "last": None,
+             "spans": 0, "events": 0, "logs": 0},
+        )
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)):
+            p["first"] = ts if p["first"] is None else min(p["first"], ts)
+            end = ts + rec.get("dur", 0) if rec.get("type") == "span" else ts
+            p["last"] = end if p["last"] is None else max(p["last"], end)
+        kind = rec.get("type")
+        if kind == "meta":
+            p["worker"] = str(rec.get("worker", p["worker"]) or p["worker"])
+            p["platform"] = str(rec.get("platform", p["platform"]) or p["platform"])
+        elif kind == "span":
+            p["spans"] += 1
+        elif kind == "event":
+            p["events"] += 1
+        elif kind == "log":
+            p["logs"] += 1
+    return procs
+
+
+def _span_table(events):
+    """span name -> (count, total_s, max_s) aggregate."""
+    table = {}
+    for rec in events:
+        if rec.get("type") != "span":
+            continue
+        name = str(rec.get("name", "?"))
+        dur = float(rec.get("dur", 0) or 0)
+        cnt, tot, mx = table.get(name, (0, 0.0, 0.0))
+        table[name] = (cnt + 1, tot + dur, max(mx, dur))
+    return table
+
+
+def _scheduler_runs(events):
+    """model id -> lifecycle rollup from the scheduler's ``scheduler.*`` events."""
+    runs = {}
+    for rec in events:
+        if rec.get("type") != "event":
+            continue
+        name = str(rec.get("name", ""))
+        if not name.startswith("scheduler."):
+            continue
+        attrs = rec.get("attrs") or {}
+        mid = attrs.get("model_id")
+        if mid is None:
+            continue
+        r = runs.setdefault(
+            mid, {"events": [], "first": None, "last": None, "pid": None}
+        )
+        stage = name.split(".", 1)[1]
+        r["events"].append(stage)
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)):
+            r["first"] = ts if r["first"] is None else min(r["first"], ts)
+            r["last"] = ts if r["last"] is None else max(r["last"], ts)
+        if stage == "start" and attrs.get("worker_pid") is not None:
+            r["pid"] = attrs["worker_pid"]
+    return runs
+
+
+def _summed_counters(events):
+    """Final metrics flush per pid, counters summed across processes."""
+    last_by_pid = {}
+    for rec in events:
+        if rec.get("type") == "metrics" and rec.get("pid") is not None:
+            last_by_pid[rec["pid"]] = rec
+    summed = {}
+    for rec in last_by_pid.values():
+        for name, value in (rec.get("counters") or {}).items():
+            if isinstance(value, (int, float)):
+                summed[name] = summed.get(name, 0) + value
+    return summed
+
+
+def summarize(events, files, bad) -> str:
+    """Render the merged run as the deterministic text summary."""
+    out = []
+    spans = [r for r in events if r.get("type") == "span"]
+    out.append(
+        f"files: {len(files)}  events: {len(events)}  spans: {len(spans)}  "
+        f"bad lines: {bad}"
+    )
+    tss = [r["ts"] for r in events if isinstance(r.get("ts"), (int, float))]
+    t0 = min(tss) if tss else 0.0
+
+    procs = _processes(events)
+    if procs:
+        out.append("")
+        out.append("processes:")
+        for pid in sorted(procs):
+            p = procs[pid]
+            first = 0.0 if p["first"] is None else p["first"] - t0
+            last = 0.0 if p["last"] is None else p["last"] - t0
+            tag = f"worker={p['worker'] or '-'} platform={p['platform'] or '-'}"
+            out.append(
+                f"  pid {pid:<8} {tag:<28} spans={p['spans']:<5} "
+                f"events={p['events']:<5} logs={p['logs']:<5} "
+                f"window={first:.3f}s..{last:.3f}s"
+            )
+
+    table = _span_table(events)
+    if table:
+        out.append("")
+        out.append("spans by name (the per-phase table):")
+        out.append(f"  {'name':<40} {'count':>6} {'total_s':>10} {'mean_s':>9} {'max_s':>9}")
+        for name in sorted(table, key=lambda n: -table[n][1]):
+            cnt, tot, mx = table[name]
+            out.append(
+                f"  {name:<40} {cnt:>6} {tot:>10.3f} {tot / cnt:>9.3f} {mx:>9.3f}"
+            )
+
+    runs = _scheduler_runs(events)
+    if runs:
+        out.append("")
+        out.append("scheduled runs:")
+        out.append(f"  {'model_id':<9} {'lifecycle':<34} {'wall_s':>8} {'worker_pid':>11}")
+        for mid in sorted(runs, key=lambda m: (str(type(m)), m)):
+            r = runs[mid]
+            wall = (
+                (r["last"] - r["first"])
+                if r["first"] is not None and r["last"] is not None
+                else 0.0
+            )
+            out.append(
+                f"  {str(mid):<9} {','.join(r['events']):<34} {wall:>8.3f} "
+                f"{str(r['pid'] if r['pid'] is not None else '-'):>11}"
+            )
+
+    counters = _summed_counters(events)
+    if counters:
+        out.append("")
+        out.append("counters (summed over processes):")
+        for name in sorted(counters):
+            out.append(f"  {name:<44} {counters[name]}")
+    return "\n".join(out)
+
+
+def to_chrome_trace(events) -> dict:
+    """The merged events as a Chrome/Perfetto ``trace_event`` document.
+
+    Timestamps become microseconds relative to the earliest event; spans are
+    ``X`` complete events, lifecycle events ``i`` instants, log records
+    ``i`` instants in a ``log`` category, and each metrics flush fans out
+    into ``C`` counter samples. Process metadata (``M``) names each track
+    group ``pid <pid> [worker i] [(platform)]``.
+    """
+    tss = [r["ts"] for r in events if isinstance(r.get("ts"), (int, float))]
+    t0 = min(tss) if tss else 0.0
+
+    def us(ts):
+        return max(0, int(round((ts - t0) * 1e6)))
+
+    trace = []
+    for pid, p in sorted(_processes(events).items()):
+        label = f"pid {pid}"
+        if p["worker"]:
+            label += f" worker {p['worker']}"
+        if p["platform"]:
+            label += f" ({p['platform']})"
+        trace.append(
+            {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+             "args": {"name": label}}
+        )
+    for rec in events:
+        kind = rec.get("type")
+        pid = rec.get("pid", 0)
+        tid = rec.get("tid", 0)
+        ts = rec.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        if kind == "span":
+            args = dict(rec.get("attrs") or {})
+            if "error" in rec:
+                args["error"] = rec["error"]
+            trace.append(
+                {"ph": "X", "name": str(rec.get("name", "?")), "cat": "span",
+                 "pid": pid, "tid": tid, "ts": us(ts),
+                 "dur": max(1, int(round(float(rec.get("dur", 0) or 0) * 1e6))),
+                 "args": args}
+            )
+        elif kind == "event":
+            trace.append(
+                {"ph": "i", "name": str(rec.get("name", "?")), "cat": "event",
+                 "pid": pid, "tid": tid, "ts": us(ts), "s": "t",
+                 "args": dict(rec.get("attrs") or {})}
+            )
+        elif kind == "log":
+            trace.append(
+                {"ph": "i", "name": f"{rec.get('level', '?')}: {rec.get('msg', '')}"[:120],
+                 "cat": "log", "pid": pid, "tid": tid, "ts": us(ts), "s": "t",
+                 "args": {"logger": rec.get("logger", "")}}
+            )
+        elif kind == "metrics":
+            for name, value in (rec.get("counters") or {}).items():
+                if isinstance(value, (int, float)):
+                    trace.append(
+                        {"ph": "C", "name": name, "pid": pid, "tid": 0,
+                         "ts": us(ts), "args": {"value": value}}
+                    )
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+#: type -> keys every event of that type must carry (the schema contract
+#: ``check`` enforces; README "Observability" documents it).
+REQUIRED_KEYS = {
+    "meta": ("ts", "pid"),
+    "span": ("ts", "dur", "name", "pid", "tid", "id", "depth"),
+    "event": ("ts", "name", "pid"),
+    "log": ("ts", "pid", "level", "msg"),
+    "metrics": ("ts", "pid", "counters", "gauges", "histograms"),
+}
+
+
+def check(events, files, bad):
+    """Validate the trace against the event schema; returns problem strings."""
+    problems = []
+    if not files:
+        problems.append("no events-*.jsonl files found")
+    first_by_file = {}
+    for rec in events:
+        f = rec["_file"]
+        head = first_by_file.get(f)
+        if head is None or rec.get("_line", 0) < head.get("_line", 0):
+            first_by_file[f] = rec
+        kind = rec.get("type")
+        if kind not in REQUIRED_KEYS:
+            problems.append(f"{f}: unknown event type {kind!r}")
+            continue
+        missing = [k for k in REQUIRED_KEYS[kind] if k not in rec]
+        if missing:
+            problems.append(f"{f}: {kind} event missing keys {missing}")
+        if kind == "span" and not (
+            isinstance(rec.get("dur"), (int, float)) and rec["dur"] >= 0
+        ):
+            problems.append(f"{f}: span {rec.get('name')!r} has bad dur")
+    for path in files:
+        name = os.path.basename(path)
+        head = first_by_file.get(name)
+        if head is not None and head.get("type") != "meta":
+            problems.append(f"{name}: first event is not the meta stamp")
+    return problems
+
+
+def main(argv=None) -> int:
+    """CLI entry point; returns the process exit code."""
+    ap = argparse.ArgumentParser(
+        prog="python -m simple_tip_tpu.obs",
+        description="Inspect a TIP_OBS_DIR run: summary table, Perfetto "
+        "export, or schema self-check.",
+    )
+    sub = ap.add_subparsers(dest="command", required=True)
+    for name, doc in (
+        ("summary", "per-process / per-phase / per-run summary table"),
+        ("export", "write Chrome/Perfetto trace_event JSON"),
+        ("check", "validate a trace against the event schema (CI)"),
+    ):
+        p = sub.add_parser(name, help=doc)
+        p.add_argument("target", nargs="+", help="run directory or .jsonl files")
+        if name == "summary":
+            p.add_argument("--json", action="store_true", help="machine-readable output")
+        if name == "export":
+            p.add_argument("-o", "--out", default="trace.json", help="output path")
+    args = ap.parse_args(argv)
+
+    events, files, bad = load_events(args.target)
+    if args.command == "summary":
+        if args.json:
+            print(
+                json.dumps(
+                    {
+                        "files": [os.path.basename(f) for f in files],
+                        "bad_lines": bad,
+                        "spans": {
+                            n: {"count": c, "total_s": t, "max_s": m}
+                            for n, (c, t, m) in sorted(_span_table(events).items())
+                        },
+                        "counters": _summed_counters(events),
+                    },
+                    indent=2,
+                    sort_keys=True,
+                )
+            )
+        else:
+            print(summarize(events, files, bad))
+        return 0
+    if args.command == "export":
+        doc = to_chrome_trace(events)
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        print(
+            f"wrote {args.out}: {len(doc['traceEvents'])} trace events from "
+            f"{len(files)} files ({bad} bad lines skipped); open in "
+            "https://ui.perfetto.dev or chrome://tracing"
+        )
+        return 0
+    problems = check(events, files, bad)
+    if problems:
+        for p in problems:
+            print(f"obs check: {p}", file=sys.stderr)
+        return 1
+    print(
+        f"obs check OK: {len(files)} files, {len(events)} events, "
+        f"{bad} torn lines skipped"
+    )
+    return 0

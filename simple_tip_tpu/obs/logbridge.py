@@ -1,0 +1,88 @@
+"""Worker log routing: spawned children's ``logger.*`` must land somewhere.
+
+``run_scheduler`` workers are fresh spawned interpreters with NO logging
+configuration, so every ``logger.info/warning/error`` in the phase code
+(scheduler claims, SA cache hits, watchdog fallbacks) was silently dropped.
+``install_worker_logging`` gives each worker two sinks:
+
+1. stderr, with a ``[pid/worker-idx]`` prefix so interleaved worker output
+   stays attributable when the parent's console multiplexes children;
+2. the obs event stream (``type: "log"`` records), when ``TIP_OBS_DIR`` is
+   set — so the run-inspection CLI can show each worker's log tail next to
+   its spans.
+
+Idempotent per process (re-install is a no-op), and the obs sink guards
+against recursion (a log record emitted while writing a log record is
+dropped, not looped).
+"""
+
+import logging
+import os
+import threading
+import time
+
+from simple_tip_tpu.obs import tracer
+
+_installed = False
+_in_emit = threading.local()
+
+
+class ObsLogHandler(logging.Handler):
+    """Route log records into the obs JSONL stream as ``log`` events."""
+
+    def emit(self, record):
+        """Write one ``log`` event; recursion- and failure-safe."""
+        if getattr(_in_emit, "on", False) or not tracer.enabled():
+            return
+        _in_emit.on = True
+        try:
+            tracer.write(
+                {
+                    "type": "log",
+                    "ts": time.time(),
+                    "pid": os.getpid(),
+                    "level": record.levelname,
+                    "logger": record.name,
+                    "msg": self.format(record),
+                }
+            )
+        except Exception:  # noqa: BLE001 — logging must never raise
+            pass
+        finally:
+            _in_emit.on = False
+
+
+def install_worker_logging(worker: str = "", level=logging.INFO) -> None:
+    """Install the worker log sinks on the root logger (idempotent).
+
+    ``worker`` is the scheduler's worker index (defaults to the
+    ``TIP_OBS_WORKER`` env var the scheduler stamps on spawn); it appears in
+    the stderr prefix as ``[pid/worker-idx]``. Existing root handlers are
+    left alone — in the parent process (which usually has its own logging
+    config) this only ADDS the obs sink, it never reformats the console.
+    """
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    worker = worker or os.environ.get("TIP_OBS_WORKER", "").strip()
+    tag = f"[{os.getpid()}/{worker}]" if worker else f"[{os.getpid()}]"
+    root = logging.getLogger()
+    if root.level > level or root.level == logging.NOTSET:
+        root.setLevel(level)
+    if not root.handlers:
+        stderr_handler = logging.StreamHandler()
+        stderr_handler.setFormatter(
+            logging.Formatter(f"{tag} %(levelname)s %(name)s: %(message)s")
+        )
+        root.addHandler(stderr_handler)
+    if tracer.enabled():
+        obs_handler = ObsLogHandler()
+        obs_handler.setFormatter(logging.Formatter("%(message)s"))
+        root.addHandler(obs_handler)
+
+
+def reset() -> None:
+    """Forget the installed state (test hook; handlers are not removed)."""
+    global _installed
+    _installed = False

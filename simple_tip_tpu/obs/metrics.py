@@ -1,0 +1,208 @@
+"""Metrics registry: counters / gauges / histograms, flushed into the stream.
+
+The registry is process-local and ALWAYS live (a counter increment is a dict
+lookup plus an add — cheap enough to leave unconditional), so callers like
+``bench.py`` can embed ``snapshot()`` in their records even when the JSONL
+stream is disabled. ``flush()`` writes the snapshot as one ``metrics`` event
+into the span stream when ``TIP_OBS_DIR`` is set, and is called automatically
+at process exit by the tracer's atexit hook.
+
+Standing instruments (populated by the instrumented seams):
+
+- ``sa_fit_cache.{hit,miss,stale,corrupt,store}``   engine/sa_prep.py
+- ``scheduler.{requeues,timeouts,worker_deaths}``   parallel/run_scheduler.py
+- ``watchdog.{probe_ok,probe_fail,probe_timeout}``  utils/device_watchdog.py
+- ``jax.compiles`` / ``jax.compile_seconds``        ``install_jax_hooks``
+- ``device.<id>.peak_bytes_in_use``                 ``record_device_memory``
+
+``install_jax_hooks`` / ``record_device_memory`` are the only functions here
+that touch jax, both behind an explicit call + try/except: the registry
+itself must stay importable in jax-free processes (fit-pool workers, the
+tier-0 CLI).
+"""
+
+import threading
+import time
+
+_lock = threading.RLock()
+_counters = {}
+_gauges = {}
+_hists = {}
+_jax_hooks_installed = False
+
+
+class Counter:
+    """Monotonic counter (``inc``); snapshots as a number."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n=1):
+        """Add ``n`` (default 1) to the counter."""
+        with _lock:
+            self.value += n
+        return self
+
+
+class Gauge:
+    """Last-value gauge with a ``set_max`` high-water helper."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = None
+
+    def set(self, v):
+        """Set the gauge to ``v``."""
+        self.value = v
+        return self
+
+    def set_max(self, v):
+        """Raise the gauge to ``v`` if higher (high-water semantics)."""
+        with _lock:
+            if self.value is None or v > self.value:
+                self.value = v
+        return self
+
+
+class Histogram:
+    """Streaming summary histogram: count / sum / min / max."""
+
+    __slots__ = ("count", "sum", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, v):
+        """Record one observation."""
+        v = float(v)
+        with _lock:
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+        return self
+
+
+def counter(name: str) -> Counter:
+    """Get-or-create the counter ``name``."""
+    with _lock:
+        c = _counters.get(name)
+        if c is None:
+            c = _counters[name] = Counter()
+        return c
+
+
+def gauge(name: str) -> Gauge:
+    """Get-or-create the gauge ``name``."""
+    with _lock:
+        g = _gauges.get(name)
+        if g is None:
+            g = _gauges[name] = Gauge()
+        return g
+
+
+def histogram(name: str) -> Histogram:
+    """Get-or-create the histogram ``name``."""
+    with _lock:
+        h = _hists.get(name)
+        if h is None:
+            h = _hists[name] = Histogram()
+        return h
+
+
+def snapshot() -> dict:
+    """Point-in-time registry state as plain JSON-safe dicts."""
+    with _lock:
+        return {
+            "counters": {k: c.value for k, c in sorted(_counters.items())},
+            "gauges": {k: g.value for k, g in sorted(_gauges.items())},
+            "histograms": {
+                k: {"count": h.count, "sum": h.sum, "min": h.min, "max": h.max}
+                for k, h in sorted(_hists.items())
+            },
+        }
+
+
+def flush() -> None:
+    """Write one ``metrics`` event with the current snapshot (if non-empty).
+
+    No-op when the stream is disabled or nothing was ever recorded; safe to
+    call repeatedly (phase boundaries, atexit).
+    """
+    from simple_tip_tpu.obs import tracer
+
+    if not tracer.enabled():
+        return
+    snap = snapshot()
+    if not (snap["counters"] or snap["gauges"] or snap["histograms"]):
+        return
+    import os
+
+    tracer.write(
+        {"type": "metrics", "ts": time.time(), "pid": os.getpid(), **snap}
+    )
+
+
+def reset() -> None:
+    """Drop every registered instrument (test hook)."""
+    global _jax_hooks_installed
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _hists.clear()
+        _jax_hooks_installed = False
+
+
+def install_jax_hooks() -> None:
+    """Count XLA compiles via ``jax.monitoring`` (idempotent, failure-safe).
+
+    Registers a duration listener on jax's monitoring bus: every
+    ``backend_compile`` event increments ``jax.compiles`` and accumulates
+    into the ``jax.compile_seconds`` histogram, so the CLI summary shows
+    recompile storms per process. Requires jax to be importable; callers
+    that may run jax-free (fit-pool workers) simply never call this.
+    """
+    global _jax_hooks_installed
+    with _lock:
+        if _jax_hooks_installed:
+            return
+        _jax_hooks_installed = True
+    try:
+        import jax.monitoring
+
+        def _on_duration(name, dur, **kw):
+            if name.endswith("/backend_compile_duration"):
+                counter("jax.compiles").inc()
+                histogram("jax.compile_seconds").observe(dur)
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # noqa: BLE001 — telemetry never takes the host down
+        pass
+
+
+def record_device_memory() -> None:
+    """High-water device memory per local device, where the backend reports it.
+
+    ``memory_stats()`` returns None on backends without allocator telemetry
+    (CPU); TPU/GPU report ``peak_bytes_in_use``, recorded as a per-device
+    high-water gauge. Failure-safe and cheap enough for phase boundaries.
+    """
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats and "peak_bytes_in_use" in stats:
+                gauge(f"device.{d.id}.peak_bytes_in_use").set_max(
+                    int(stats["peak_bytes_in_use"])
+                )
+    except Exception:  # noqa: BLE001 — telemetry never takes the host down
+        pass

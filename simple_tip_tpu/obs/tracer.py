@@ -1,0 +1,329 @@
+"""Span tracer: nested, attributed spans written as append-only JSONL.
+
+One event stream per process, under ``TIP_OBS_DIR``:
+
+- unset / ``0`` / ``off``  -> telemetry fully disabled: ``span()`` returns a
+  shared no-op context manager, no directory is created, zero files are
+  written (overhead is pinned by tests/test_obs.py);
+- ``1`` / ``auto``         -> ``$TIP_ASSETS/obs/<run_ts>/``, resolved ONCE in
+  the first process that emits and re-exported into ``os.environ`` so every
+  spawned child (run_scheduler workers, SA fit pool, bench subprocesses)
+  appends into the SAME run directory;
+- any other value          -> that directory, verbatim.
+
+Each process owns exactly one file (``events-<pid>-<token>.jsonl``; the
+token keeps restarts from interleaving two boots in one file) and opens it
+lazily on the first real event. The first line is a ``meta`` event stamping
+pid / worker index / platform (``TIP_OBS_WORKER`` / ``TIP_OBS_PLATFORM``,
+set by the scheduler when it spawns workers), which is how the CLI merges
+streams across the spawn boundary. Every write is one ``json.dumps`` line
+plus flush — a crashed process leaves a file whose complete lines all still
+parse (the reader skips at most the torn tail line).
+
+Span semantics: context manager (``with span("fit", variant="dsa"):``) or
+decorator (``@traced()``); nesting is tracked per thread, each span records
+its wall-clock start (``time.time``, cross-process alignable), a monotonic
+duration (``time.perf_counter``), its parent span id and depth, and
+arbitrary JSON-safe attributes. Spans are written on EXIT only: an event
+that never closed (crash mid-span) is absent rather than half-written.
+
+Everything here is stdlib-only (json/os/time/threading): the tracer is
+imported by pool workers and the tier-0 CLI, neither of which may pay (or
+wedge on) a jax import.
+"""
+
+import atexit
+import json
+import os
+import secrets
+import sys
+import threading
+import time
+
+_lock = threading.RLock()
+_local = threading.local()
+
+# Resolved lazily on first use; _State.pid lets a forked child detect that it
+# inherited the parent's handle and must re-resolve (spawn re-imports anyway).
+_state = None
+
+
+class _State:
+    """Per-process tracer state: resolved directory, lazy file handle."""
+
+    __slots__ = ("enabled", "dir", "path", "fh", "pid", "next_id", "meta_written")
+
+    def __init__(self, enabled, directory):
+        self.enabled = enabled
+        self.dir = directory
+        self.path = None
+        self.fh = None
+        self.pid = os.getpid()
+        self.next_id = 0
+        self.meta_written = False
+
+
+def _resolve():
+    """Build this process's ``_State`` from ``TIP_OBS_DIR`` (see module doc)."""
+    raw = os.environ.get("TIP_OBS_DIR", "").strip()
+    if not raw or raw.lower() in ("0", "off"):
+        return _State(False, None)
+    if raw.lower() in ("1", "auto"):
+        assets = os.environ.get("TIP_ASSETS", os.path.join(os.getcwd(), "assets"))
+        raw = os.path.join(assets, "obs", time.strftime("%Y%m%d-%H%M%S"))
+        # Children (spawned workers / pools) inherit os.environ: pinning the
+        # resolved path here is what merges the whole study into one run dir.
+        os.environ["TIP_OBS_DIR"] = raw
+    return _State(True, os.path.abspath(raw))
+
+
+def _get_state():
+    """The process-wide tracer state, (re)resolved on first use or after fork."""
+    global _state
+    st = _state
+    if st is None or st.pid != os.getpid():
+        with _lock:
+            st = _state
+            if st is None or st.pid != os.getpid():
+                st = _resolve()
+                _state = st
+    return st
+
+
+def enabled() -> bool:
+    """Whether telemetry is active for this process (``TIP_OBS_DIR`` set)."""
+    return _get_state().enabled
+
+
+def obs_dir():
+    """The resolved event-stream directory, or None when disabled."""
+    return _get_state().dir
+
+
+def reset() -> None:
+    """Close the stream and drop cached state so the env is re-read.
+
+    Test/tooling hook: production processes resolve once and never reset.
+    """
+    global _state
+    with _lock:
+        if _state is not None and _state.fh is not None:
+            try:
+                _state.fh.close()
+            except OSError:
+                pass
+        _state = None
+        _local.__dict__.clear()
+
+
+def _close_at_exit() -> None:
+    """atexit hook: flush the metrics registry, then close the stream."""
+    from simple_tip_tpu.obs import metrics
+
+    metrics.flush()
+    st = _state
+    if st is not None and st.fh is not None:
+        try:
+            st.fh.close()
+        except OSError:
+            pass
+        st.fh = None
+
+
+def _meta_event() -> dict:
+    """The stream-head ``meta`` event stamping this process's identity."""
+    worker = os.environ.get("TIP_OBS_WORKER", "").strip()
+    platform = os.environ.get("TIP_OBS_PLATFORM", "").strip()
+    rec = {
+        "type": "meta",
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "argv": list(sys.argv),
+    }
+    if worker:
+        rec["worker"] = worker
+    if platform:
+        rec["platform"] = platform
+    return rec
+
+
+def write(rec: dict) -> None:
+    """Append one event line to this process's stream (no-op when disabled).
+
+    Never raises: a full disk or revoked directory degrades telemetry to
+    silence, not the pipeline to failure.
+    """
+    st = _get_state()
+    if not st.enabled:
+        return
+    with _lock:
+        try:
+            if st.fh is None:
+                os.makedirs(st.dir, exist_ok=True)
+                st.path = os.path.join(
+                    st.dir,
+                    f"events-{os.getpid()}-{secrets.token_hex(4)}.jsonl",
+                )
+                st.fh = open(st.path, "a", encoding="utf-8")
+                atexit.register(_close_at_exit)
+            if not st.meta_written:
+                st.meta_written = True
+                st.fh.write(json.dumps(_meta_event(), default=repr) + "\n")
+            st.fh.write(json.dumps(rec, default=repr) + "\n")
+            st.fh.flush()
+        except OSError:
+            # Telemetry must never take the instrumented pipeline down.
+            st.enabled = False
+
+
+def _span_stack():
+    """This thread's open-span stack (span ids)."""
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = _local.stack = []
+    return stack
+
+
+def _new_span_id(st) -> str:
+    """Process-unique span id (``pid:n``)."""
+    with _lock:
+        st.next_id += 1
+        return f"{st.pid}:{st.next_id}"
+
+
+class _NoopSpan:
+    """Shared do-nothing span for the disabled path (near-zero overhead)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        """Ignore attribute updates on the disabled path."""
+        return self
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    """One live span: records wall start, monotonic duration, nesting."""
+
+    __slots__ = ("name", "attrs", "_id", "_parent", "_depth", "_t0", "_wall")
+
+    def __init__(self, name, attrs):
+        self.name = name
+        self.attrs = attrs
+
+    def set(self, **attrs):
+        """Attach/overwrite attributes while the span is open."""
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        st = _get_state()
+        stack = _span_stack()
+        self._id = _new_span_id(st)
+        self._parent = stack[-1] if stack else None
+        self._depth = len(stack)
+        stack.append(self._id)
+        self._wall = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        dur = time.perf_counter() - self._t0
+        stack = _span_stack()
+        if stack and stack[-1] == self._id:
+            stack.pop()
+        rec = {
+            "type": "span",
+            "name": self.name,
+            "ts": self._wall,
+            "dur": dur,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "id": self._id,
+            "depth": self._depth,
+        }
+        if self._parent is not None:
+            rec["parent"] = self._parent
+        if exc_type is not None:
+            rec["error"] = repr(exc_val)
+        if self.attrs:
+            rec["attrs"] = self.attrs
+        write(rec)
+        return False
+
+
+def span(name: str, **attrs):
+    """A context-manager span; the shared no-op when telemetry is disabled."""
+    if not _get_state().enabled:
+        return _NOOP
+    return Span(name, attrs)
+
+
+def traced(name=None, **attrs):
+    """Decorator form of ``span`` (span name defaults to the qualname)."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        def wrapper(*args, **kwargs):
+            with span(label, **attrs):
+                return fn(*args, **kwargs)
+
+        wrapper.__name__ = fn.__name__
+        wrapper.__doc__ = fn.__doc__
+        wrapper.__qualname__ = fn.__qualname__
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    return deco
+
+
+def event(name: str, **attrs) -> None:
+    """One instantaneous lifecycle event (scheduler announce/done/requeue...)."""
+    if not _get_state().enabled:
+        return
+    rec = {
+        "type": "event",
+        "name": name,
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "tid": threading.get_ident(),
+    }
+    if attrs:
+        rec["attrs"] = attrs
+    write(rec)
+
+
+def record_span(name: str, wall_start: float, dur: float, **attrs) -> None:
+    """Record an externally-timed span (the ``Timer`` mirror path).
+
+    The caller owns the measurement (``wall_start`` from ``time.time``,
+    ``dur`` in seconds); nesting attaches to this thread's current open span.
+    """
+    st = _get_state()
+    if not st.enabled:
+        return
+    stack = _span_stack()
+    rec = {
+        "type": "span",
+        "name": name,
+        "ts": wall_start,
+        "dur": dur,
+        "pid": os.getpid(),
+        "tid": threading.get_ident(),
+        "id": _new_span_id(st),
+        "depth": len(stack),
+    }
+    if stack:
+        rec["parent"] = stack[-1]
+    if attrs:
+        rec["attrs"] = attrs
+    write(rec)

@@ -1,8 +1,20 @@
-"""Wall-clock timing utilities.
+"""Monotonic timing utilities.
 
 Mirrors the semantics of the reference's timer (reference: src/core/timer.py:6-50):
 re-entrant accumulation over start/stop segments, context-manager and decorator
 forms, RuntimeError on misuse and a RuntimeWarning when read while running.
+
+Two deliberate departures from the reference implementation (semantics kept):
+
+- segments are measured with ``time.perf_counter()``, not ``time.time()``:
+  wall-clock is not monotonic, so an NTP step or leap-second smear during a
+  segment would corrupt the accumulated total (negative or wildly inflated
+  phase records);
+- a Timer constructed with ``name=`` optionally mirrors every completed
+  segment into the obs span stream (simple_tip_tpu/obs), so the four-stage
+  phase timings show up on the run flame chart without a second timing
+  system. Mirroring is a no-op (and costs one attribute check) when
+  ``TIP_OBS_DIR`` is unset.
 
 Adds ``device_timed`` for accurate on-device timing: JAX dispatch is async, so a
 naive wall-clock around a jitted call measures dispatch, not compute. We bracket
@@ -14,11 +26,20 @@ import warnings
 
 
 class Timer:
-    """Accumulating wall-clock timer (start/stop, context manager, decorator)."""
+    """Accumulating monotonic timer (start/stop, context manager, decorator).
 
-    def __init__(self, start: bool = False):
+    ``name`` opts the timer into span mirroring: each completed start/stop
+    segment is recorded as one obs span of that name (with ``attrs``
+    attached), preserving the reference's accumulated-seconds contract
+    while making the segments individually visible on the trace.
+    """
+
+    def __init__(self, start: bool = False, name: str = None, **attrs):
         self._start_time = None
         self._elapsed = 0.0
+        self._name = name
+        self._attrs = attrs
+        self._wall_start = None
         if start:
             self.start()
 
@@ -26,14 +47,21 @@ class Timer:
         """Start the timer; it must not already be running."""
         if self._start_time is not None:
             raise RuntimeError("Timer is already started")
-        self._start_time = time.time()
+        if self._name is not None:
+            self._wall_start = time.time()
+        self._start_time = time.perf_counter()
 
     def stop(self):
         """Stop the timer; it must be running."""
         if self._start_time is None:
             raise RuntimeError("Timer is not started")
-        self._elapsed += time.time() - self._start_time
+        segment = time.perf_counter() - self._start_time
+        self._elapsed += segment
         self._start_time = None
+        if self._name is not None:
+            from simple_tip_tpu import obs
+
+            obs.record_span(self._name, self._wall_start, segment, **self._attrs)
 
     def timed(self, f):
         """Decorator: accumulate the wrapped function's wall-clock into this timer."""

@@ -18,6 +18,7 @@ Memory scales with the member-group size (activations are materialized per
 member under vmap), so retrainings run in groups of ``group_size``.
 """
 
+import logging
 import math
 from functools import partial
 from typing import List, Optional, Tuple
@@ -25,6 +26,8 @@ from typing import List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from simple_tip_tpu.models.train import (
     TrainConfig,
@@ -178,9 +181,12 @@ def al_retrain_ensemble(
                 this_rngs,
             )
             if verbose:
-                print(
-                    f"AL group {g_start // group_size}: epoch {epoch + 1}/"
-                    f"{cfg.epochs} loss={np.asarray(losses).mean():.4f}"
+                logger.info(
+                    "AL group %d: epoch %d/%d loss=%.4f",
+                    g_start // group_size,
+                    epoch + 1,
+                    cfg.epochs,
+                    np.asarray(losses).mean(),
                 )
         for i in range(n_real):
             results.append(jax.tree.map(lambda leaf: np.asarray(leaf[i]), params))

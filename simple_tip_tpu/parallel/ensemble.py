@@ -10,6 +10,7 @@ keeps its own rng stream (init, per-epoch shuffle, dropout), so the ensemble
 is statistically identical to N independent trainings.
 """
 
+import logging
 import math
 from functools import partial
 from typing import List, Optional
@@ -18,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
 
 from simple_tip_tpu.models.train import (
     TrainConfig,
@@ -125,9 +128,11 @@ def train_ensemble(
         )
         if verbose:
             losses = np.asarray(losses)
-            print(
-                f"ensemble epoch {epoch + 1}/{cfg.epochs} "
-                f"mean_loss={losses[:n_models].mean():.4f}"
+            logger.info(
+                "ensemble epoch %d/%d mean_loss=%.4f",
+                epoch + 1,
+                cfg.epochs,
+                losses[:n_models].mean(),
             )
 
     # Drop padding members.

@@ -51,6 +51,8 @@ import queue as queue_mod
 import time
 from typing import Dict, List, Optional
 
+from simple_tip_tpu import obs
+
 logger = logging.getLogger(__name__)
 
 # Grace added to run_timeout_s before presuming a silent worker pool wedged
@@ -145,6 +147,11 @@ PHASES = {
 def _worker_main(case_study, phase, work_q, done_q, stop_event, phase_kwargs, env_overrides):
     """Entry point of one spawned worker process."""
     os.environ.update(env_overrides)
+    # Fresh interpreter, no logging config: without this, every logger.* in
+    # the phase code (cache hits, watchdog fallbacks) is silently dropped.
+    # Routes records to stderr with a [pid/worker-idx] prefix and — when
+    # TIP_OBS_DIR is set — into this worker's obs event stream.
+    obs.install_worker_logging()
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         # Make the CPU pin binding before any backend init: on deployments
         # whose sitecustomize pre-registers an accelerator plugin the env
@@ -158,6 +165,9 @@ def _worker_main(case_study, phase, work_q, done_q, stop_event, phase_kwargs, en
     from simple_tip_tpu.config import enable_compilation_cache
 
     enable_compilation_cache()
+    # jax is imported (and the backend chosen) by the case-study machinery
+    # above; count this worker's XLA compiles from here on.
+    obs.install_jax_hooks()
     cs = get_case_study(case_study)
     fn = PHASES[phase]
     while True:
@@ -170,21 +180,29 @@ def _worker_main(case_study, phase, work_q, done_q, stop_event, phase_kwargs, en
             model_id = work_q.get(timeout=0.5)
         except queue_mod.Empty:
             if stop_event.is_set():
+                # Explicit flush (not only atexit): the scheduler may
+                # terminate() a worker that dallies at shutdown.
+                obs.flush_metrics()
                 return
             continue
         # Announce the claim so the scheduler can detect a wedged/killed
         # worker holding this id and requeue it.
         done_q.put(("start", model_id, os.getpid()))
         try:
-            fn(cs, [model_id], **phase_kwargs)
+            with obs.span(
+                "run", phase=phase, case_study=case_study, model_id=model_id
+            ):
+                fn(cs, [model_id], **phase_kwargs)
             done_q.put(("done", model_id, None))
         except (KeyboardInterrupt, SystemExit) as e:
             # Report the interrupted id, then actually stop — an interrupted
             # worker must not keep draining the queue.
             done_q.put(("done", model_id, repr(e)))
+            obs.flush_metrics()
             raise
         except BaseException as e:  # noqa: BLE001 — reported; scheduler decides
             done_q.put(("done", model_id, repr(e)))
+        obs.record_device_memory()
 
 
 def default_worker_platforms(num_workers: int, local_chips: int) -> List[str]:
@@ -228,6 +246,17 @@ def run_phase_parallel(
         run_timeout_s = float(os.environ.get("TIP_RUN_TIMEOUT_S", "3600"))
     phase_kwargs = dict(phase_kwargs or {})
 
+    # Resolve the obs run directory BEFORE any spawn: an ``auto``
+    # TIP_OBS_DIR pins itself into os.environ here, so every worker (which
+    # inherits the parent environment) appends into the SAME run directory
+    # and the streams merge across the spawn boundary.
+    obs.enabled()
+    phase_span = obs.span(
+        "scheduler.phase", phase=phase, case_study=case_study,
+        runs=len(model_ids), workers=num_workers,
+    )
+    phase_span.__enter__()
+
     ctx = mp.get_context("spawn")
     work_q = ctx.Queue()
     # Retries ride a SEPARATE queue read only by the CPU-pinned replacement
@@ -239,12 +268,17 @@ def run_phase_parallel(
     stop_event = ctx.Event()
     for m in model_ids:
         work_q.put(m)
+        obs.event("scheduler.announce", model_id=m, phase=phase)
 
     workers: List = []
     worker_queue: Dict[int, object] = {}  # pid -> the queue that worker reads
 
     def _spawn(platform: str, queue=work_q):
         env = {"JAX_PLATFORMS": "cpu"} if platform == "cpu" else {}
+        # Stamp the worker's stream identity: index + platform land in the
+        # child's meta event and its stderr log prefix.
+        env["TIP_OBS_WORKER"] = str(len(workers))
+        env["TIP_OBS_PLATFORM"] = platform
         w = ctx.Process(
             target=_worker_main,
             args=(case_study, phase, queue, done_q, stop_event, phase_kwargs, env),
@@ -278,6 +312,10 @@ def run_phase_parallel(
                 "pid": payload,
                 "deadline": time.time() + run_timeout_s,
             }
+            obs.event(
+                "scheduler.start", model_id=model_id, phase=phase,
+                worker_pid=payload,
+            )
             return
         in_flight.pop(model_id, None)
         if model_id in results:
@@ -285,9 +323,14 @@ def run_phase_parallel(
         results[model_id] = payload
         if payload is None:
             logger.info("[%s] %s: run %d done", case_study, phase, model_id)
+            obs.event("scheduler.done", model_id=model_id, phase=phase)
         else:
             logger.error(
                 "[%s] %s: run %d FAILED: %s", case_study, phase, model_id, payload
+            )
+            obs.event(
+                "scheduler.fail", model_id=model_id, phase=phase,
+                error=str(payload)[:300],
             )
 
     def _reap_stuck() -> None:
@@ -304,6 +347,9 @@ def run_phase_parallel(
                 if worker_dead
                 else f"no result after {run_timeout_s:.0f}s (wedged device call?)"
             )
+            obs.counter(
+                "scheduler.worker_deaths" if worker_dead else "scheduler.timeouts"
+            ).inc()
             if w is not None and w.is_alive():
                 logger.error(
                     "[%s] %s: run %d %s — terminating worker pid %s",
@@ -329,6 +375,11 @@ def run_phase_parallel(
                 logger.warning(
                     "[%s] %s: requeueing run %d onto a fresh CPU-pinned worker (%s)",
                     case_study, phase, model_id, reason,
+                )
+                obs.counter("scheduler.requeues").inc()
+                obs.event(
+                    "scheduler.requeue", model_id=model_id, phase=phase,
+                    reason=reason,
                 )
                 retry_q.put(model_id)
                 _spawn("cpu", queue=retry_q)
@@ -392,6 +443,12 @@ def run_phase_parallel(
         if w.is_alive():  # pragma: no cover — wedged worker (dead tunnel)
             logger.error("worker pid %s wedged at shutdown; terminating", w.pid)
             w.terminate()
+
+    phase_span.set(
+        completed=sum(1 for e in results.values() if e is None),
+        failed=sum(1 for e in results.values() if e is not None),
+    ).__exit__(None, None, None)
+    obs.flush_metrics()
 
     failed = {m: e for m, e in results.items() if e is not None}
     missing = [m for m in model_ids if m not in results]

@@ -3,6 +3,7 @@ A12 effect sizes over the (dataset, future)-split AL accuracies, emitting
 the heatmap and ``results/active_correlation_{p,eff}.csv`` (artifact
 contract: src/plotters/eval_active_correlation.py)."""
 
+import logging
 from typing import Dict
 
 from simple_tip_tpu.plotters import utils
@@ -11,6 +12,8 @@ from simple_tip_tpu.plotters.eval_active_learning_table import (
     load_arrays_active_learning,
 )
 from simple_tip_tpu.plotters.utils import identify_incomplete_values, named_tuples
+
+logger = logging.getLogger(__name__)
 
 _EXTENDED = [*utils.APPROACHES, "original", "random"]
 
@@ -32,7 +35,7 @@ def _future_split_accuracies(case_study: str, dataset: str) -> Dict[str, Dict[in
 def _warn_missing(cs: str, ds: str, values) -> None:
     missing = identify_incomplete_values(values, has_dropout=cs != "cifar10")
     if missing:
-        print(f"Missing values {cs} - {ds}: {missing}")
+        logger.warning("Missing values %s - %s: %s", cs, ds, missing)
 
 
 def run(case_studies=("mnist", "fmnist", "cifar10", "imdb"), plot: bool = True):

@@ -3,6 +3,7 @@ sizes across all (case study x dataset) APFD values, emitting the heatmap
 and ``results/apfd_correlation_{p,eff}.csv`` (artifact contract:
 src/plotters/eval_apfd_correlation.py)."""
 
+import logging
 from typing import Dict
 
 from simple_tip_tpu.plotters import utils
@@ -10,11 +11,13 @@ from simple_tip_tpu.plotters.correlation_plot import pooled_statistics
 from simple_tip_tpu.plotters.eval_apfd_table import load_apfd_values
 from simple_tip_tpu.plotters.utils import identify_incomplete_values, named_tuples
 
+logger = logging.getLogger(__name__)
+
 
 def _warn_missing(cs: str, ds: str, values) -> None:
     missing = identify_incomplete_values(values, has_dropout=cs != "cifar10")
     if missing:
-        print(f"Missing values {cs} - {ds}: {missing}")
+        logger.warning("Missing values %s - %s: %s", cs, ds, missing)
 
 
 def run(case_studies=("mnist", "fmnist", "cifar10", "imdb"), plot: bool = True):

@@ -24,6 +24,8 @@ import os
 import subprocess
 import sys
 
+from simple_tip_tpu import obs
+
 logger = logging.getLogger(__name__)
 
 _PROBE = (
@@ -72,17 +74,20 @@ def probe_local_chips(timeout_s: float = 90.0) -> int:
             if proc.returncode == 0 and out.strip():
                 platform, n = out.strip().splitlines()[-1].split()
                 chips = 0 if platform == "cpu" else int(n)
+                obs.counter("watchdog.probe_ok").inc()
             else:
                 logger.error(
                     "chip-count probe exited %s (stderr tail: %s) — assuming 0",
                     proc.returncode,
                     (err or "").strip()[-300:],
                 )
+                obs.counter("watchdog.probe_fail").inc()
         except subprocess.TimeoutExpired:
             logger.error(
                 "chip-count probe unresponsive after %.0fs — assuming 0 chips",
                 timeout_s,
             )
+            obs.counter("watchdog.probe_timeout").inc()
             proc.kill()
             try:
                 proc.wait(timeout=5)
@@ -124,18 +129,25 @@ def ensure_responsive_backend(timeout_s: float = 90.0) -> str:
         try:
             out, err = proc.communicate(timeout=timeout_s)
             if proc.returncode == 0 and out.strip():
-                return out.strip().splitlines()[-1]
+                platform = out.strip().splitlines()[-1]
+                obs.counter("watchdog.probe_ok").inc()
+                obs.event("watchdog.probe", outcome="ok", platform=platform)
+                return platform
             logger.error(
                 "device probe exited %s (stderr tail: %s) — falling back to CPU",
                 proc.returncode,
                 err.strip()[-300:],
             )
+            obs.counter("watchdog.probe_fail").inc()
+            obs.event("watchdog.probe", outcome="fail", rc=proc.returncode)
         except subprocess.TimeoutExpired:
             logger.error(
                 "default accelerator unresponsive after %.0fs — falling back "
                 "to CPU",
                 timeout_s,
             )
+            obs.counter("watchdog.probe_timeout").inc()
+            obs.event("watchdog.probe", outcome="timeout", timeout_s=timeout_s)
             proc.kill()
             try:
                 # bounded: a child wedged in an uninterruptible device ioctl

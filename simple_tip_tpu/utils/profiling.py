@@ -1,25 +1,40 @@
 """Profiling hooks.
 
 The reference's only tracing is wall-clock Timers (SURVEY.md section 5); this
-build keeps that timing schema and adds optional XLA-level traces: set
-``TIP_PROFILE_DIR`` to capture a ``jax.profiler`` trace (viewable in
-TensorBoard / Perfetto) around any phase wrapped in ``maybe_trace``.
+build layers two optional capture planes over that schema, both driven by
+``maybe_trace(label)``:
+
+- set ``TIP_OBS_DIR`` (simple_tip_tpu/obs) and every ``maybe_trace`` phase is
+  an obs span — the label lands on the run flame chart next to the scheduler
+  and engine spans, with the XLA trace directory cross-referenced when both
+  planes are on;
+- set ``TIP_PROFILE_DIR`` to additionally capture a ``jax.profiler`` trace
+  (viewable in TensorBoard / Perfetto) around the phase.
+
+With neither set, ``maybe_trace`` is a no-op context manager.
 """
 
 import contextlib
 import os
 
+from simple_tip_tpu import obs
+
 
 @contextlib.contextmanager
 def maybe_trace(label: str):
-    """Context manager: jax profiler trace when TIP_PROFILE_DIR is set."""
+    """Context manager: obs span when TIP_OBS_DIR is set, plus a jax
+    profiler trace when TIP_PROFILE_DIR is set."""
     profile_dir = os.environ.get("TIP_PROFILE_DIR")
-    if not profile_dir:
-        yield
-        return
-    import jax
+    span_attrs = {"kind": "phase"}
+    if profile_dir:
+        span_attrs["xla_trace_dir"] = os.path.join(profile_dir, label)
+    with obs.span(label, **span_attrs):
+        if not profile_dir:
+            yield
+            return
+        import jax
 
-    out = os.path.join(profile_dir, label)
-    os.makedirs(out, exist_ok=True)
-    with jax.profiler.trace(out):
-        yield
+        out = os.path.join(profile_dir, label)
+        os.makedirs(out, exist_ok=True)
+        with jax.profiler.trace(out):
+            yield

@@ -13,6 +13,12 @@ lazily, so updating ``jax.config`` before the first computation still wins.
 import os
 import sys
 
+# A TIP_OBS_DIR inherited from the developer's shell would make every test
+# process stream telemetry into one real run directory (and perturb the
+# no-op overhead pin); tests that need telemetry enable it per-test via
+# monkeypatch + obs.reset_all().
+os.environ.pop("TIP_OBS_DIR", None)
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

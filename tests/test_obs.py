@@ -1,0 +1,314 @@
+"""Telemetry subsystem (simple_tip_tpu/obs) contract tests.
+
+Pinned here, per the subsystem's three promises:
+
+1. correctness: span nesting/attributes/decorator, metrics registry,
+   ``auto`` directory resolution pinning the env for children, the worker
+   log bridge, cross-process stream merge (two real writer processes →
+   one ordered trace);
+2. zero cost when off: with ``TIP_OBS_DIR`` unset, spans are no-op-level
+   (absolute per-span bound) and ZERO files/directories are created;
+3. inspectability: the CLI summary golden on the committed fixture trace
+   (a scheduler-shaped two-process run), the Chrome ``trace_event`` export
+   schema, and the ``check`` self-check including torn-tail tolerance.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import simple_tip_tpu.obs as obs
+from simple_tip_tpu.obs.cli import check, load_events, main, to_chrome_trace
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO_ROOT, "tests", "fixtures", "obs_trace")
+
+
+@pytest.fixture
+def obs_dir(tmp_path, monkeypatch):
+    """An enabled, isolated obs run directory (reset before and after)."""
+    d = tmp_path / "obsrun"
+    monkeypatch.setenv("TIP_OBS_DIR", str(d))
+    obs.reset_all()
+    yield d
+    obs.reset_all()
+
+
+def _events(d):
+    evs, _files, _bad = load_events(str(d))
+    return evs
+
+
+# --- correctness -------------------------------------------------------------
+
+
+def test_span_nesting_attributes_and_decorator(obs_dir):
+    with obs.span("outer", phase="test"):
+        with obs.span("inner", k=1) as sp:
+            sp.set(extra="late")
+
+    @obs.traced("workload", tag="deco")
+    def workload():
+        """Traced workload."""
+        return 42
+
+    assert workload() == 42
+    spans = {e["name"]: e for e in _events(obs_dir) if e["type"] == "span"}
+    assert spans["outer"]["depth"] == 0 and "parent" not in spans["outer"]
+    assert spans["inner"]["depth"] == 1
+    assert spans["inner"]["parent"] == spans["outer"]["id"]
+    assert spans["inner"]["attrs"] == {"k": 1, "extra": "late"}
+    assert spans["outer"]["attrs"] == {"phase": "test"}
+    assert spans["workload"]["attrs"] == {"tag": "deco"}
+    assert all(s["dur"] >= 0 for s in spans.values())
+
+
+def test_span_records_exception_and_unwinds_stack(obs_dir):
+    with pytest.raises(ValueError):
+        with obs.span("boom"):
+            raise ValueError("x")
+    with obs.span("after"):
+        pass
+    spans = {e["name"]: e for e in _events(obs_dir) if e["type"] == "span"}
+    assert "ValueError" in spans["boom"]["error"]
+    assert spans["after"]["depth"] == 0  # the failed span did not leak depth
+
+
+def test_metrics_registry_and_flush(obs_dir):
+    obs.counter("c").inc().inc(2)
+    obs.gauge("g").set_max(5)
+    obs.gauge("g").set_max(3)  # lower: high-water keeps 5
+    obs.histogram("h").observe(1.0)
+    obs.histogram("h").observe(3.0)
+    snap = obs.metrics_snapshot()
+    assert snap["counters"]["c"] == 3
+    assert snap["gauges"]["g"] == 5
+    assert snap["histograms"]["h"] == {"count": 2, "sum": 4.0, "min": 1.0, "max": 3.0}
+    obs.flush_metrics()
+    flushed = [e for e in _events(obs_dir) if e["type"] == "metrics"]
+    assert flushed and flushed[-1]["counters"]["c"] == 3
+
+
+def test_auto_dir_resolves_under_assets_and_pins_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path))
+    monkeypatch.setenv("TIP_OBS_DIR", "auto")
+    obs.reset_all()
+    try:
+        assert obs.enabled()
+        resolved = obs.obs_dir()
+        assert resolved.startswith(os.path.join(str(tmp_path), "obs"))
+        # Children inherit the RESOLVED path, not 'auto': one run dir.
+        assert os.environ["TIP_OBS_DIR"] == resolved
+    finally:
+        obs.reset_all()
+
+
+def test_worker_log_bridge_routes_records_to_stream(obs_dir, monkeypatch):
+    import logging
+
+    monkeypatch.setenv("TIP_OBS_WORKER", "3")
+    import simple_tip_tpu.obs.logbridge as logbridge
+
+    root = logging.getLogger()
+    before = list(root.handlers)
+    try:
+        obs.install_worker_logging()
+        logging.getLogger("simple_tip_tpu.test").info("hello from worker")
+    finally:
+        root.handlers[:] = before
+        logbridge.reset()
+    logs = [e for e in _events(obs_dir) if e["type"] == "log"]
+    assert any(e["msg"] == "hello from worker" and e["level"] == "INFO" for e in logs)
+
+
+_WRITER = """
+import sys, time
+sys.path.insert(0, {repo!r})
+import simple_tip_tpu.obs as obs
+with obs.span("child_work", idx={idx}):
+    time.sleep(0.05)
+obs.counter("child.done").inc()
+obs.flush_metrics()
+"""
+
+
+def test_cross_process_merge_two_writers(obs_dir, monkeypatch):
+    """Two real writer processes -> one ts-ordered trace with both pids."""
+    monkeypatch.setenv("TIP_OBS_WORKER", "w")
+    procs = [
+        subprocess.run(
+            [sys.executable, "-c", _WRITER.format(repo=REPO_ROOT, idx=i)],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        for i in range(2)
+    ]
+    assert all(p.returncode == 0 for p in procs), [p.stderr for p in procs]
+    events = _events(obs_dir)
+    files = {e["_file"] for e in events}
+    assert len(files) == 2, "each process must own its own stream file"
+    spans = [e for e in events if e["type"] == "span" and e["name"] == "child_work"]
+    assert sorted(s["attrs"]["idx"] for s in spans) == [0, 1]
+    assert len({s["pid"] for s in spans}) == 2
+    tss = [e["ts"] for e in events]
+    assert tss == sorted(tss), "merge must be ts-ordered"
+    # Metrics flushes from both children sum in the CLI rollup.
+    from simple_tip_tpu.obs.cli import _summed_counters
+
+    assert _summed_counters(events) == {"child.done": 2}
+    # Both meta events carry the worker stamp inherited from the env.
+    metas = [e for e in events if e["type"] == "meta"]
+    assert len(metas) == 2 and all(m.get("worker") == "w" for m in metas)
+
+
+def test_scheduler_run_produces_merged_inspectable_trace(obs_dir, tmp_path):
+    """The acceptance shape: a >=2-worker scheduler phase with TIP_OBS_DIR
+    set yields worker-stamped streams that merge into per-run lifecycle
+    rows, worker 'run' spans, and a valid Chrome trace."""
+    from simple_tip_tpu.obs.cli import _scheduler_runs
+    from simple_tip_tpu.parallel.run_scheduler import run_phase_parallel
+
+    marker = tmp_path / "markers"
+    marker.mkdir()
+    run_phase_parallel(
+        "mnist",  # registry name; the sleep phase never touches its data
+        "_test_sleep",
+        model_ids=[0, 1, 2],
+        num_workers=2,
+        phase_kwargs={"seconds": 0.1, "marker_dir": str(marker)},
+        worker_platforms=["cpu", "cpu"],
+    )
+    events = _events(obs_dir)
+    metas = [e for e in events if e["type"] == "meta"]
+    workers = {m.get("worker") for m in metas if "worker" in m}
+    assert {"0", "1"} <= workers, f"worker stamps missing: {metas}"
+    assert all(m.get("platform") == "cpu" for m in metas if "worker" in m)
+    runs = _scheduler_runs(events)
+    assert set(runs) == {0, 1, 2}
+    assert all(
+        r["events"][:2] == ["announce", "start"] and r["events"][-1] == "done"
+        for r in runs.values()
+    )
+    run_spans = [e for e in events if e["type"] == "span" and e["name"] == "run"]
+    assert sorted(s["attrs"]["model_id"] for s in run_spans) == [0, 1, 2]
+    phase_spans = [
+        e for e in events if e["type"] == "span" and e["name"] == "scheduler.phase"
+    ]
+    assert len(phase_spans) == 1
+    assert phase_spans[0]["attrs"]["completed"] == 3
+    problems = check(*load_events(str(obs_dir)))
+    assert not problems, problems
+    assert to_chrome_trace(events)["traceEvents"]
+
+
+# --- zero cost when off ------------------------------------------------------
+
+
+def test_disabled_spans_are_noop_level_and_write_nothing(tmp_path, monkeypatch):
+    """The acceptance pin: TIP_OBS_DIR unset -> near-zero overhead, no files."""
+    monkeypatch.delenv("TIP_OBS_DIR", raising=False)
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path))
+    obs.reset_all()
+    try:
+        assert not obs.enabled()
+        n = 10_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with obs.span("noop"):
+                pass
+        per_span = (time.perf_counter() - t0) / n
+        # No-op span measures ~1-2us; 50us/span is an order-of-magnitude
+        # slack for loaded CI while still catching an accidental file open
+        # or env re-read per span (each >= 1ms-class).
+        assert per_span < 50e-6, f"no-op span costs {per_span * 1e6:.1f}us"
+        obs.event("nothing")
+        obs.flush_metrics()
+        assert os.listdir(tmp_path) == [], "disabled obs must write NOTHING"
+    finally:
+        obs.reset_all()
+
+
+# --- inspectability ----------------------------------------------------------
+
+
+def test_cli_summary_golden_on_fixture(capsys):
+    """The committed scheduler-shaped fixture renders byte-identically.
+
+    The fixture is the same two-process shape a mini_env scheduler run
+    produces (parent lifecycle events + a worker's run/sa_fit/coverage
+    spans); regenerate the golden with
+    ``python -m simple_tip_tpu.obs summary tests/fixtures/obs_trace``.
+    """
+    assert main(["summary", FIXTURE]) == 0
+    got = capsys.readouterr().out
+    with open(os.path.join(FIXTURE, "summary.golden.txt")) as f:
+        assert got == f.read()
+
+
+def test_cli_check_passes_on_fixture(capsys):
+    assert main(["check", FIXTURE]) == 0
+    assert "obs check OK" in capsys.readouterr().out
+
+
+def test_check_flags_schema_violations(tmp_path):
+    p = tmp_path / "events-1-x.jsonl"
+    p.write_text(
+        '{"type": "span", "ts": 1.0, "name": "no-required-keys"}\n'
+    )
+    events, files, bad = load_events(str(tmp_path))
+    problems = check(events, files, bad)
+    assert any("missing keys" in s for s in problems)
+    assert any("meta stamp" in s for s in problems)
+
+
+def test_torn_tail_lines_are_skipped_not_fatal(obs_dir):
+    with obs.span("ok"):
+        pass
+    obs.reset()  # close the stream so the append below is the file tail
+    files = [f for f in os.listdir(obs_dir) if f.endswith(".jsonl")]
+    with open(obs_dir / files[0], "a") as f:
+        f.write('{"type": "span", "name": "torn...')  # crash mid-write
+    events, _files, bad = load_events(str(obs_dir))
+    assert bad == 1
+    assert [e["name"] for e in events if e["type"] == "span"] == ["ok"]
+
+
+def test_perfetto_export_schema(tmp_path):
+    events, _f, _b = load_events(FIXTURE)
+    doc = to_chrome_trace(events)
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    assert json.loads(json.dumps(doc))  # JSON-serializable end to end
+    phases = {e["ph"] for e in doc["traceEvents"]}
+    assert {"M", "X", "i", "C"} <= phases
+    for e in doc["traceEvents"]:
+        assert {"ph", "name", "pid"} <= set(e)
+        if e["ph"] == "X":
+            assert e["dur"] >= 1 and e["ts"] >= 0 and "tid" in e
+        if e["ph"] in ("X", "i", "C"):
+            assert isinstance(e["ts"], int)
+    # Process metadata names both fixture processes, worker-stamped.
+    names = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert names == {"pid 1000", "pid 1001 worker 0 (cpu)"}
+
+
+def test_cli_export_via_module_entrypoint(tmp_path):
+    out = tmp_path / "trace.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "simple_tip_tpu.obs", "export", FIXTURE, "-o", str(out)],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(out.read_text())
+    assert doc["traceEvents"]

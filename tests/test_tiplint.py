@@ -501,8 +501,47 @@ def host_loop(xs):
 ''',
 }
 
+BAD_BARE_PRINT = {
+    "engine/worker.py": '''"""m."""
+
+
+def report_progress(i):
+    """d."""
+    print(f"run {i} done")
+''',
+}
+
+GOOD_BARE_PRINT = {
+    # Entry-point modules (cli.py/__main__.py) are script surface: exempt.
+    "cli.py": '''"""m."""
+
+
+def main():
+    """d."""
+    print("usage: ...")
+''',
+    "engine/worker.py": '''"""m."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def report_progress(i):
+    """d."""
+    logger.info("run %d done", i)
+''',
+    # Test modules are exempt wherever they live.
+    "engine/test_worker.py": '''"""m."""
+
+
+def test_noise():
+    print("assert context")
+''',
+}
+
 FIXTURES = {
     "jit-purity": (BAD_JIT_PURITY, GOOD_JIT_PURITY),
+    "bare-print": (BAD_BARE_PRINT, GOOD_BARE_PRINT),
     "prng-hygiene": (BAD_PRNG, GOOD_PRNG),
     "host-sync": (BAD_HOST_SYNC, GOOD_HOST_SYNC),
     "f64-on-tpu": (BAD_F64, GOOD_F64),
