@@ -40,6 +40,13 @@ shape — the prio phase's dominant host cost per HOST_PHASE.json;
 (seconds per 1000 obs span cycles in the current TIP_OBS_DIR state, so the
 trajectory catches telemetry regressions) and the process's obs metrics
 snapshot (``obs_metrics``: compile counts, watchdog probe outcomes, ...).
+
+Cross-round regression loop (obs v2): when a previous round's
+``BENCH_r*.json`` sits next to this script, the record also embeds
+``vs_previous`` — the ``obs regress`` comparison against it (value ratio,
+degraded flip, health-counter growth, SA fit-time growth) — so a platform
+degradation or slowdown is visible IN the record the moment it happens
+instead of silently replacing the last good number.
 """
 
 import json
@@ -426,6 +433,25 @@ def main():
             "mfu": 0.0,
             "error": "all measurement attempts failed or timed out",
         }
+    # Delta vs the previous round's committed bench record (obs v2): the
+    # regress comparator flags a degraded flip / value drop / health-counter
+    # growth right in the record. Companion data — never fatal, and the
+    # import is stdlib-only (simple_tip_tpu.obs.regress touches no jax).
+    try:
+        from simple_tip_tpu.obs import regress as obs_regress
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        rounds = sorted(
+            n
+            for n in os.listdir(here)
+            if n.startswith("BENCH_r") and n.endswith(".json")
+        )
+        if rounds:
+            rec["vs_previous"] = obs_regress.bench_delta(
+                rec, os.path.join(here, rounds[-1])
+            )
+    except Exception:  # noqa: BLE001 — the one-JSON-line contract wins
+        pass
     if rec.get("degraded", True):
         last_good = _load_last_good_tpu()
         if last_good is not None:

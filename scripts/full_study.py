@@ -67,6 +67,13 @@ def main() -> int:
     logging.basicConfig(level=logging.INFO if args.verbose else logging.WARNING)
     log = logging.getLogger("full_study")
 
+    # Telemetry on by default once an artifact bus exists to hold it:
+    # `auto` resolves to $TIP_ASSETS/obs/<run_ts> and pins the run dir into
+    # the env, so every phase worker on this host streams into it (the
+    # rotating writer caps the footprint; TIP_OBS_DIR=off opts out).
+    if os.environ.get("TIP_ASSETS") and not os.environ.get("TIP_OBS_DIR"):
+        os.environ["TIP_OBS_DIR"] = "auto"
+
     phases = [p.strip() for p in args.phases.split(",") if p.strip()]
     unknown = set(phases) - set(ALL_PHASES)
     if unknown:
@@ -141,6 +148,21 @@ def main() -> int:
         f"{jax.local_device_count()} local device(s), platform {platform}"
     )
 
+    from simple_tip_tpu import obs
+
+    obs.install_jax_hooks()
+    # Study root span (per host): every phase span and scheduler worker
+    # below nests under it, so the whole study exports as one flame-chart
+    # tree (`python -m simple_tip_tpu.obs export $TIP_ASSETS/obs/<run>`).
+    study_span = obs.study_root(
+        "full_study",
+        case_studies=",".join(case_studies),
+        phases=",".join(phases),
+        runs=len(my_runs),
+        host=jax.process_index(),
+    )
+    study_span.__enter__()
+
     for phase in phases:
         if phase == "evaluation":
             # Aggregation reads every host's artifacts off the shared
@@ -175,11 +197,19 @@ def main() -> int:
         for cs_name in case_studies:
             cs = get_case_study(cs_name)
             t0 = time.perf_counter()
-            dispatch_phase(cs, phase, my_runs, num_workers=max(1, args.workers))
+            with obs.span(phase, cs=cs_name, runs=len(my_runs)):
+                dispatch_phase(cs, phase, my_runs, num_workers=max(1, args.workers))
             print(
                 f"[{phase}:{cs_name}] runs {my_runs[0]}..{my_runs[-1]} "
                 f"in {time.perf_counter() - t0:.0f}s"
             )
+    study_span.__exit__(None, None, None)
+    obs.flush_metrics()
+    if obs.enabled():
+        print(
+            f"obs events in {obs.obs_dir()} — inspect with "
+            f"`python -m simple_tip_tpu.obs summary {obs.obs_dir()}`"
+        )
     return 0
 
 

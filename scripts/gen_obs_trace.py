@@ -1,0 +1,128 @@
+"""Generate a fresh scheduler-shaped 2-worker obs trace (CI, stdlib-only).
+
+The committed fixture (tests/fixtures/obs_trace) pins the CLI's rendering,
+but a fixture cannot prove the WRITER still produces merge-able streams.
+This script exercises the real tracer end to end without jax — so the
+dependency-free lint job can validate a freshly generated trace, not only
+a committed one:
+
+- the parent opens a ``study_root`` span (pinning ``TIP_OBS_ROOT`` across
+  the spawn boundary) and emits scheduler-shaped lifecycle events;
+- each worker is a REAL child interpreter (worker-stamped via
+  ``TIP_OBS_WORKER``/``TIP_OBS_PLATFORM``) writing nested spans, a
+  metrics flush, and one span carrying ``xla_trace_dir``/``xla_started_ts``
+  pointing at a synthetic profiler capture (``*.trace.json.gz``), so
+  ``obs export --splice-xla`` has a device timeline to splice.
+
+Usage: python scripts/gen_obs_trace.py --out /tmp/obs_ci_trace [--workers 2]
+Prints the run directory; exit nonzero if any worker failed.
+"""
+
+import argparse
+import gzip
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_WORKER_SRC = """
+import gzip, json, os, sys, time
+sys.path.insert(0, {repo!r})
+import simple_tip_tpu.obs as obs
+
+model_id = {model_id}
+xla_dir = {xla_dir!r}
+
+# Synthetic profiler capture in the TensorBoard layout, so the splice path
+# exercises discovery + gunzip + time-shift on a REAL file.
+cap = os.path.join(xla_dir, "plugins", "profile", "000")
+os.makedirs(cap, exist_ok=True)
+dev_events = {{
+    "traceEvents": [
+        {{"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+          "args": {{"name": "/device:TPU:0"}}}},
+        {{"ph": "X", "name": "fusion.1", "pid": 1, "tid": 1,
+          "ts": 1000.0, "dur": 400.0, "args": {{}}}},
+        {{"ph": "X", "name": "copy.2", "pid": 1, "tid": 1,
+          "ts": 1450.0, "dur": 100.0, "args": {{}}}},
+    ]
+}}
+with gzip.open(os.path.join(cap, "host.trace.json.gz"), "wt") as f:
+    json.dump(dev_events, f)
+
+with obs.span("run", phase="_ci_gen", model_id=model_id):
+    with obs.span("sa_fit", variant="dsa", cached=False):
+        time.sleep(0.02)
+    with obs.span(
+        "device_phase",
+        kind="phase",
+        xla_trace_dir=xla_dir,
+        xla_started_ts=time.time(),
+    ):
+        time.sleep(0.02)
+obs.counter("sa_fit_cache.miss").inc()
+obs.flush_metrics()
+"""
+
+
+def main() -> int:
+    """Generate the trace; print its directory; nonzero on worker failure."""
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/tmp/obs_ci_trace")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument(
+        "--max-bytes",
+        default=None,
+        help="optional TIP_OBS_MAX_BYTES for the generated run",
+    )
+    args = ap.parse_args()
+
+    os.environ["TIP_OBS_DIR"] = args.out
+    if args.max_bytes is not None:
+        os.environ["TIP_OBS_MAX_BYTES"] = str(args.max_bytes)
+
+    import simple_tip_tpu.obs as obs
+
+    rc = 0
+    with obs.study_root("gen_obs_trace", workers=args.workers):
+        with obs.span("scheduler.phase", phase="_ci_gen", runs=args.workers):
+            for i in range(args.workers):
+                obs.event("scheduler.announce", model_id=i, phase="_ci_gen")
+            procs = []
+            for i in range(args.workers):
+                env = dict(os.environ)
+                env["TIP_OBS_WORKER"] = str(i)
+                env["TIP_OBS_PLATFORM"] = "cpu"
+                xla_dir = os.path.join(args.out + "_xla", f"run{i}")
+                src = _WORKER_SRC.format(repo=REPO, model_id=i, xla_dir=xla_dir)
+                procs.append(
+                    subprocess.Popen(
+                        [sys.executable, "-c", src],
+                        env=env,
+                        stderr=subprocess.PIPE,
+                        text=True,
+                    )
+                )
+                obs.event(
+                    "scheduler.start", model_id=i, phase="_ci_gen",
+                    worker_pid=procs[-1].pid,
+                )
+            for i, p in enumerate(procs):
+                _out, err = p.communicate(timeout=120)
+                if p.returncode == 0:
+                    obs.event("scheduler.done", model_id=i, phase="_ci_gen")
+                else:
+                    rc = 1
+                    obs.event("scheduler.fail", model_id=i, phase="_ci_gen")
+                    print(f"worker {i} failed:\n{err}", file=sys.stderr)
+    obs.flush_metrics()
+    print(obs.obs_dir())
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,3 +19,14 @@ python -m simple_tip_tpu.analysis simple_tip_tpu scripts tests \
 # tooling (simple_tip_tpu/obs — also stdlib-only) must keep parsing the
 # documented event schema, or post-hoc study inspection silently breaks.
 python -m simple_tip_tpu.obs check tests/fixtures/obs_trace
+# Regression-gate self-check (obs v2): the detector must fire on the
+# committed before/after fixture pair (synthetic 2x slowdown + degraded
+# bench flip) and stay silent on identical inputs — a detector that stops
+# detecting is worse than none.
+if python -m simple_tip_tpu.obs regress tests/fixtures/obs_regress/base tests/fixtures/obs_regress/slow >/dev/null 2>&1; then
+  echo "lint.sh: obs regress missed the synthetic slowdown fixture" >&2; exit 1
+fi
+if python -m simple_tip_tpu.obs regress tests/fixtures/obs_regress/bench_base.json tests/fixtures/obs_regress/bench_degraded.json >/dev/null 2>&1; then
+  echo "lint.sh: obs regress missed the degraded bench flip fixture" >&2; exit 1
+fi
+python -m simple_tip_tpu.obs regress tests/fixtures/obs_regress/base tests/fixtures/obs_regress/base >/dev/null

@@ -61,10 +61,13 @@ def _sa_stage(cs, model_id: int, cache_dir: str, label: str) -> dict:
         case_study=cs.spec.name,
         model_id=model_id,
     )
+    from simple_tip_tpu import obs
+
     t0 = time.time()
-    results = handler.evaluate_all(
-        {"nominal": x_test, "ood": x_ood}, dsa_badge_size=cs.spec.dsa_badge_size
-    )
+    with obs.span("sa_stage", cache=label):
+        results = handler.evaluate_all(
+            {"nominal": x_test, "ood": x_ood}, dsa_badge_size=cs.spec.dsa_badge_size
+        )
     wall = round(time.time() - t0, 1)
     setups = {v: round(results[v]["nominal"][2][0], 2) for v in results}
     out = {
@@ -99,6 +102,11 @@ def main() -> int:
     os.environ["TIP_ASSETS"] = args.assets
     os.environ.setdefault("TIP_DATA_DIR", "/tmp/host_phase_none")
     os.environ["TIP_SYNTH_SCALE"] = "paper"
+    # Telemetry on by default (TIP_ASSETS is set just above, so `auto`
+    # lands under this measurement's own assets dir); TIP_OBS_DIR=off
+    # opts out. The measured stages become spans under one study root, so
+    # a slow capture can be read post hoc like any other run.
+    os.environ.setdefault("TIP_OBS_DIR", "auto")
 
     import jax
 
@@ -108,7 +116,13 @@ def main() -> int:
 
     import dataclasses
 
+    from simple_tip_tpu import obs
     from simple_tip_tpu.casestudies.base import CASE_STUDIES, CaseStudy
+
+    obs.install_worker_logging()
+    obs.install_jax_hooks()
+    study_span = obs.study_root("measure_host_phase", sa_only=bool(args.sa_only))
+    study_span.__enter__()
 
     spec = CASE_STUDIES["mnist"]
     # One training epoch: the checkpoint just needs to exist (see docstring).
@@ -141,7 +155,8 @@ def main() -> int:
     except (OSError, ValueError):
         pass
     t0 = time.time()
-    cs.train([0])
+    with obs.span("train_1epoch"):
+        cs.train([0])
     train_s = round(time.time() - t0, 1)
     if train_s < 1.0:
         # Checkpoint reuse: the skip time is NOT the train cost. Carry the
@@ -197,6 +212,8 @@ def main() -> int:
         from simple_tip_tpu.utils.artifacts_io import atomic_write_json
 
         atomic_write_json(args.out, record)
+        study_span.__exit__(None, None, None)
+        obs.flush_metrics()
         print(json.dumps(record["sa_setup"]))
         return 0
 
@@ -205,7 +222,8 @@ def main() -> int:
     # serial history.
     shutil.rmtree(sa_cache_dir, ignore_errors=True)
     t0 = time.time()
-    cs.run_prio_eval([0])
+    with obs.span("test_prio"):
+        cs.run_prio_eval([0])
     record["test_prio_s"] = round(time.time() - t0, 1)
     print(f"test_prio: {record['test_prio_s']}s", flush=True)
 
@@ -259,6 +277,8 @@ def main() -> int:
     from simple_tip_tpu.utils.artifacts_io import atomic_write_json
 
     atomic_write_json(args.out, record)
+    study_span.__exit__(None, None, None)
+    obs.flush_metrics()
     print(json.dumps({k: v for k, v in record.items() if k != "times_sum_by_metric"}))
     return 0
 

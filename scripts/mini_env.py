@@ -74,6 +74,11 @@ def bootstrap(assets: str = "/tmp/mini_study_assets") -> None:
     # bus was built with BEFORE any loader can generate data from a
     # mismatched env (fails loudly; see verify_hardness_pin).
     verify_hardness_pin(os.environ["TIP_ASSETS"])
+    # Telemetry on by default for studies: TIP_ASSETS is pinned above, so
+    # `auto` lands the run dir under this bus ($TIP_ASSETS/obs/<run_ts>).
+    # The rotating writer caps the footprint (TIP_OBS_MAX_BYTES, 64 MiB/
+    # process default); export TIP_OBS_DIR=off to opt out entirely.
+    os.environ.setdefault("TIP_OBS_DIR", "auto")
     # Same-backend workers => reproducible artifacts (SCALING.md note).
     os.environ.setdefault("TIP_WORKER_PLATFORMS", "cpu")
     # One AL run is ~80 sequential CPU retrains (~40 min alone, slower under

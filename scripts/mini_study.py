@@ -64,6 +64,14 @@ def main() -> int:
     run_ids = list(range(args.runs))
     timings = {}
     fault_rates = {}
+    # Study root span: every phase span below AND every scheduler worker's
+    # top-level span (the root id travels through os.environ across the
+    # spawn boundary) nests under this one node, so the exported flame
+    # chart is a single study tree.
+    study_span = obs.study_root(
+        "mini_study", runs=args.runs, workers=args.workers
+    )
+    study_span.__enter__()
     for cs_name in CASE_STUDIES:
         cs = provide(cs_name)
         t0 = time.time()
@@ -150,6 +158,7 @@ def main() -> int:
         run_all_evals(CASE_STUDIES)
     timings["evaluation"] = round(time.time() - t0, 1)
     print(f"evaluations done in {timings['evaluation']}s", flush=True)
+    study_span.__exit__(None, None, None)
     obs.flush_metrics()
     if obs.enabled():
         print(

@@ -17,4 +17,5 @@ from simple_tip_tpu.analysis.rules import (  # noqa: F401
     shape_poly,
     sharding_spec,
     transitive_purity,
+    wallclock_duration,
 )

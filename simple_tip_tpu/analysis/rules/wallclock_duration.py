@@ -1,0 +1,86 @@
+"""Rule ``wallclock-duration``: ``time.time()`` subtraction measures clock
+steps, not durations.
+
+``time.time()`` is wall clock: NTP steps, leap-second smears and manual
+clock changes move it mid-measurement, so ``time.time() - t0`` in library
+code can go negative or inflate a phase record by hours — exactly the
+corruption the PR 4 timer fix removed from ``ops/timer.py``. The repo idiom
+since then is ``time.perf_counter()`` for durations and ``time.monotonic()``
+for deadlines; ``time.time()`` remains correct for *timestamps* (the obs
+tracer's cross-process-alignable ``ts`` fields), which is why only the
+SUBTRACTION pattern is flagged, not the call itself.
+
+Detected: any ``a - b`` where either operand is a direct ``time.time()``
+call (module alias and ``from time import time`` forms included). The
+two-names form (``t1 - t0`` with both assigned from ``time.time()``
+earlier) is out of scope for this syntactic rule — the sweep showed every
+real offender in the package used the direct form.
+
+Exempt (same surface logic as ``bare-print``): the ``scripts/`` and
+``tests/`` trees and test modules, where wall-clock phase prints are the
+interface and cross-process timestamps get subtracted legitimately.
+"""
+
+import ast
+from typing import Iterator, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.bare_print import _exempt
+
+
+def _time_aliases(tree: ast.Module):
+    """(module aliases of ``time``, name aliases of ``time.time``)."""
+    mod_aliases, fn_aliases = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    mod_aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "time":
+                    fn_aliases.add(a.asname or a.name)
+    return mod_aliases, fn_aliases
+
+
+def _is_wallclock_call(node, mod_aliases, fn_aliases) -> bool:
+    """Whether ``node`` is a direct ``time.time()`` call."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "time":
+        return isinstance(fn.value, ast.Name) and fn.value.id in mod_aliases
+    return isinstance(fn, ast.Name) and fn.id in fn_aliases
+
+
+@register
+class WallclockDurationRule(Rule):
+    """Flag ``time.time()`` subtraction (duration use) in library code."""
+
+    name = "wallclock-duration"
+    description = (
+        "time.time() subtraction in library code: wall clock is not "
+        "monotonic, so NTP steps corrupt the measured duration; use "
+        "time.perf_counter() for durations / time.monotonic() for "
+        "deadlines (scripts/tests exempt)"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Flag Sub expressions with a ``time.time()`` operand."""
+        if _exempt(module):
+            return
+        mod_aliases, fn_aliases = _time_aliases(module.tree)
+        if not (mod_aliases or fn_aliases):
+            return
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)):
+                continue
+            if _is_wallclock_call(node.left, mod_aliases, fn_aliases) or (
+                _is_wallclock_call(node.right, mod_aliases, fn_aliases)
+            ):
+                yield "", node.lineno, (
+                    "duration measured by subtracting time.time(): wall "
+                    "clock is not monotonic (NTP steps corrupt the value); "
+                    "use time.perf_counter() for durations or "
+                    "time.monotonic() for deadlines"
+                )

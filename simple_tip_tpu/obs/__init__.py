@@ -9,9 +9,16 @@ spawn boundary by worker stamping, and a CLI that renders a whole study as a
 per-phase summary table or one Perfetto/Chrome flame chart:
 
 - ``obs.span("fit", variant="dsa")`` / ``@obs.traced()``  nested spans
+- ``obs.study_root("mini_study")``                        study root span
 - ``obs.event("scheduler.requeue", model_id=3)``          lifecycle events
 - ``obs.counter("sa_fit_cache.hit").inc()``               metrics registry
-- ``python -m simple_tip_tpu.obs summary|export|check``   run inspection
+- ``python -m simple_tip_tpu.obs summary|export|check|regress``  inspection
+
+obs v2 adds the trace lifecycle (``TIP_OBS_MAX_BYTES`` rotating size cap
+with oldest-segment eviction, ``TIP_OBS_SAMPLE`` keep-1-in-N span
+sampling, the ``study_root`` span every process's top spans nest under),
+``export --splice-xla`` (device timelines merged into the host flame
+chart) and ``regress`` (cross-run per-phase/metric regression gating).
 
 Zero third-party dependencies (stdlib json), crash-safe (append-only JSONL;
 partial files still parse line-wise), and no-op when ``TIP_OBS_DIR`` is
@@ -24,6 +31,7 @@ from simple_tip_tpu.obs.metrics import (
     gauge,
     histogram,
     install_jax_hooks,
+    poll_device_memory,
     record_device_memory,
     snapshot as metrics_snapshot,
     flush as flush_metrics,
@@ -35,6 +43,7 @@ from simple_tip_tpu.obs.tracer import (
     record_span,
     reset,
     span,
+    study_root,
     traced,
 )
 
@@ -49,10 +58,12 @@ __all__ = [
     "install_worker_logging",
     "metrics_snapshot",
     "obs_dir",
+    "poll_device_memory",
     "record_device_memory",
     "record_span",
     "reset",
     "span",
+    "study_root",
     "traced",
 ]
 

@@ -14,6 +14,20 @@
 - ``check``    validate a trace (CI self-check): every line parses or is
   counted as a torn tail, every event carries the schema's required keys,
   every file opens with its ``meta`` stamp. Exit 1 on schema violations.
+- ``regress``  diff two runs (trace dirs, ``summary --json`` documents or
+  bench records — see ``obs/regress.py``) per phase/metric; prints the
+  comparison table and exits nonzero on any regression (phase-duration
+  growth past the threshold, a ``degraded`` false->true flip, health
+  counter growth). ``--against`` names the baseline explicitly; with no
+  current operand the newest ``BENCH_r*.json`` in the working directory
+  is compared.
+
+``export --splice-xla`` additionally reads each span's ``xla_trace_dir``
+attribute (written by ``utils/profiling.maybe_trace`` when
+``TIP_PROFILE_DIR`` is set), parses the XLA profiler's own trace-event
+JSON, shifts it onto the span clock and emits the device timelines into
+the SAME Perfetto file, grouped under ``xla:<span>`` track groups — the
+host story and the device story in one flame chart (``obs/splice.py``).
 
 Merging is tolerant by construction: files are read line-wise, unparsable
 lines (a crash's torn tail) are skipped and counted, and ordering is by the
@@ -25,9 +39,37 @@ Stdlib-only: this CLI is part of the tier-0 gate (no jax/numpy installed).
 """
 
 import argparse
+import datetime
 import json
 import os
 import sys
+
+
+def _iso_utc(ts) -> str:
+    """Epoch seconds as UTC ISO-8601 with millisecond precision."""
+    if not isinstance(ts, (int, float)):
+        return "-"
+    dt = datetime.datetime.fromtimestamp(ts, datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+
+
+def filter_phase(events, phase: str):
+    """The events belonging to ``phase``: spans named it (or attributed to
+    it via ``attrs.phase``) and lifecycle events attributed to it. ``meta``
+    events survive so process identity still renders; metrics/log records
+    are dropped (they are not phase-scoped and would mislead)."""
+    kept = []
+    for rec in events:
+        kind = rec.get("type")
+        if kind == "meta":
+            kept.append(rec)
+            continue
+        if kind not in ("span", "event"):
+            continue
+        attrs = rec.get("attrs") or {}
+        if rec.get("name") == phase or attrs.get("phase") == phase:
+            kept.append(rec)
+    return kept
 
 
 def iter_trace_files(target):
@@ -176,6 +218,8 @@ def summarize(events, files, bad) -> str:
     )
     tss = [r["ts"] for r in events if isinstance(r.get("ts"), (int, float))]
     t0 = min(tss) if tss else 0.0
+    if tss:
+        out.append(f"start: {_iso_utc(t0)}")
 
     procs = _processes(events)
     if procs:
@@ -207,7 +251,10 @@ def summarize(events, files, bad) -> str:
     if runs:
         out.append("")
         out.append("scheduled runs:")
-        out.append(f"  {'model_id':<9} {'lifecycle':<34} {'wall_s':>8} {'worker_pid':>11}")
+        out.append(
+            f"  {'model_id':<9} {'start_utc':<26} {'lifecycle':<34} "
+            f"{'wall_s':>8} {'worker_pid':>11}"
+        )
         for mid in sorted(runs, key=lambda m: (str(type(m)), m)):
             r = runs[mid]
             wall = (
@@ -216,7 +263,8 @@ def summarize(events, files, bad) -> str:
                 else 0.0
             )
             out.append(
-                f"  {str(mid):<9} {','.join(r['events']):<34} {wall:>8.3f} "
+                f"  {str(mid):<9} {_iso_utc(r['first']):<26} "
+                f"{','.join(r['events']):<34} {wall:>8.3f} "
                 f"{str(r['pid'] if r['pid'] is not None else '-'):>11}"
             )
 
@@ -285,12 +333,16 @@ def to_chrome_trace(events) -> dict:
                  "args": {"logger": rec.get("logger", "")}}
             )
         elif kind == "metrics":
-            for name, value in (rec.get("counters") or {}).items():
-                if isinstance(value, (int, float)):
-                    trace.append(
-                        {"ph": "C", "name": name, "pid": pid, "tid": 0,
-                         "ts": us(ts), "args": {"value": value}}
-                    )
+            # Counters AND gauges become counter tracks: the per-device
+            # memory high-water (device.*.peak_bytes_in_use gauges, polled
+            # by the scheduler loop) graphs over the run this way.
+            for source in ("counters", "gauges"):
+                for name, value in (rec.get(source) or {}).items():
+                    if isinstance(value, (int, float)):
+                        trace.append(
+                            {"ph": "C", "name": name, "pid": pid, "tid": 0,
+                             "ts": us(ts), "args": {"value": value}}
+                        )
     return {"traceEvents": trace, "displayTimeUnit": "ms"}
 
 
@@ -335,12 +387,83 @@ def check(events, files, bad):
     return problems
 
 
+def _newest_bench_record(cwd: str):
+    """The newest ``BENCH_r*.json`` in ``cwd`` (by round number), or None."""
+    names = sorted(
+        n
+        for n in os.listdir(cwd)
+        if n.startswith("BENCH_r") and n.endswith(".json")
+    )
+    return os.path.join(cwd, names[-1]) if names else None
+
+
+def _regress(args) -> int:
+    """``obs regress`` entry: resolve operands, compare, print, exit code."""
+    from simple_tip_tpu.obs import regress as regress_mod
+
+    targets = list(args.targets)
+    baseline_path = args.against
+    if baseline_path is None:
+        if len(targets) < 2:
+            print(
+                "obs regress: need BASELINE and CURRENT (or --against BASELINE)",
+                file=sys.stderr,
+            )
+            return 2
+        baseline_path = targets.pop(0)
+    if targets:
+        current_path = targets.pop(0)
+    else:
+        # `obs regress --against BENCH_r04.json`: current defaults to the
+        # newest bench round record in the working directory.
+        current_path = _newest_bench_record(os.getcwd())
+        if current_path is None or os.path.abspath(current_path) == os.path.abspath(
+            baseline_path
+        ):
+            print(
+                "obs regress: no CURRENT operand and no newer BENCH_r*.json "
+                "in the working directory",
+                file=sys.stderr,
+            )
+            return 2
+    if targets:
+        print(f"obs regress: unexpected extra operands {targets}", file=sys.stderr)
+        return 2
+    try:
+        baseline = regress_mod.load_snapshot(baseline_path)
+        current = regress_mod.load_snapshot(current_path)
+    except ValueError as e:
+        print(f"obs regress: {e}", file=sys.stderr)
+        return 2
+    kwargs = {}
+    if args.max_growth is not None:
+        kwargs["max_growth"] = args.max_growth
+    result = regress_mod.compare(baseline, current, **kwargs)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "baseline": baseline["source"],
+                    "current": current["source"],
+                    "ok": result["ok"],
+                    "rows": result["rows"],
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    else:
+        print(regress_mod.render(result, baseline, current))
+    return 0 if result["ok"] else 1
+
+
 def main(argv=None) -> int:
     """CLI entry point; returns the process exit code."""
     ap = argparse.ArgumentParser(
         prog="python -m simple_tip_tpu.obs",
         description="Inspect a TIP_OBS_DIR run: summary table, Perfetto "
-        "export, or schema self-check.",
+        "export (optionally with spliced XLA timelines), schema "
+        "self-check, or cross-run regression detection.",
     )
     sub = ap.add_subparsers(dest="command", required=True)
     for name, doc in (
@@ -352,12 +475,55 @@ def main(argv=None) -> int:
         p.add_argument("target", nargs="+", help="run directory or .jsonl files")
         if name == "summary":
             p.add_argument("--json", action="store_true", help="machine-readable output")
+            p.add_argument(
+                "--phase",
+                default=None,
+                metavar="NAME",
+                help="only spans/events of this phase (span name or "
+                "attrs.phase match)",
+            )
         if name == "export":
             p.add_argument("-o", "--out", default="trace.json", help="output path")
+            p.add_argument(
+                "--splice-xla",
+                action="store_true",
+                help="splice XLA profiler traces (each span's xla_trace_dir) "
+                "into the same file, time-shifted onto the span clock",
+            )
+    rp = sub.add_parser(
+        "regress",
+        help="diff two runs/bench records; exit nonzero on regressions",
+    )
+    rp.add_argument(
+        "targets",
+        nargs="*",
+        help="BASELINE CURRENT (run dirs, summary --json files, or bench "
+        "records); with --against, just CURRENT",
+    )
+    rp.add_argument(
+        "--against",
+        default=None,
+        metavar="BASELINE",
+        help="baseline snapshot (e.g. a previous BENCH_r0*.json)",
+    )
+    rp.add_argument(
+        "--max-growth",
+        type=float,
+        default=None,
+        metavar="FRAC",
+        help="phase-duration growth (and bench value drop) threshold as a "
+        "fraction (default 0.25)",
+    )
+    rp.add_argument("--json", action="store_true", help="machine-readable output")
     args = ap.parse_args(argv)
+
+    if args.command == "regress":
+        return _regress(args)
 
     events, files, bad = load_events(args.target)
     if args.command == "summary":
+        if args.phase:
+            events = filter_phase(events, args.phase)
         if args.json:
             print(
                 json.dumps(
@@ -379,6 +545,16 @@ def main(argv=None) -> int:
         return 0
     if args.command == "export":
         doc = to_chrome_trace(events)
+        if args.splice_xla:
+            from simple_tip_tpu.obs import splice as splice_mod
+
+            tss = [
+                r["ts"] for r in events if isinstance(r.get("ts"), (int, float))
+            ]
+            spliced, report = splice_mod.splice(events, min(tss) if tss else 0.0)
+            doc["traceEvents"].extend(spliced)
+            for line in report:
+                print(f"splice: {line}", file=sys.stderr)
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(doc, f)
         print(

@@ -62,12 +62,19 @@ def install_worker_logging(worker: str = "", level=logging.INFO) -> None:
     config) this only ADDS the obs sink, it never reformats the console.
     """
     global _installed
-    if _installed:
+    root = logging.getLogger()
+    # Idempotence is decided by INSPECTING the root logger, not only the
+    # module flag: a scheduler phase that requeues after a worker death (or
+    # a test's reset_all()) may re-enter here in a process whose logger
+    # already carries the bridge — adding a second ObsLogHandler would
+    # duplicate every record in the event stream from then on.
+    has_bridge = any(isinstance(h, ObsLogHandler) for h in root.handlers)
+    if _installed or has_bridge:
+        _installed = True
         return
     _installed = True
     worker = worker or os.environ.get("TIP_OBS_WORKER", "").strip()
     tag = f"[{os.getpid()}/{worker}]" if worker else f"[{os.getpid()}]"
-    root = logging.getLogger()
     if root.level > level or root.level == logging.NOTSET:
         root.setLevel(level)
     if not root.handlers:

@@ -29,6 +29,10 @@ _counters = {}
 _gauges = {}
 _hists = {}
 _jax_hooks_installed = False
+# json.dumps of the last snapshot this process flushed into the stream:
+# periodic pollers (the scheduler's device-memory poll) call flush() on a
+# timer, and an unchanged registry must not spam identical metrics events.
+_last_flushed = None
 
 
 class Counter:
@@ -134,9 +138,12 @@ def snapshot() -> dict:
 def flush() -> None:
     """Write one ``metrics`` event with the current snapshot (if non-empty).
 
-    No-op when the stream is disabled or nothing was ever recorded; safe to
-    call repeatedly (phase boundaries, atexit).
+    No-op when the stream is disabled, nothing was ever recorded, or the
+    snapshot is byte-identical to the last one this process flushed (so
+    periodic pollers do not spam duplicate events); safe to call
+    repeatedly (phase boundaries, poll timers, atexit).
     """
+    global _last_flushed
     from simple_tip_tpu.obs import tracer
 
     if not tracer.enabled():
@@ -144,6 +151,13 @@ def flush() -> None:
     snap = snapshot()
     if not (snap["counters"] or snap["gauges"] or snap["histograms"]):
         return
+    import json
+
+    encoded = json.dumps(snap, sort_keys=True, default=repr)
+    with _lock:
+        if encoded == _last_flushed:
+            return
+        _last_flushed = encoded
     import os
 
     tracer.write(
@@ -153,12 +167,13 @@ def flush() -> None:
 
 def reset() -> None:
     """Drop every registered instrument (test hook)."""
-    global _jax_hooks_installed
+    global _jax_hooks_installed, _last_flushed
     with _lock:
         _counters.clear()
         _gauges.clear()
         _hists.clear()
         _jax_hooks_installed = False
+        _last_flushed = None
 
 
 def install_jax_hooks() -> None:
@@ -206,3 +221,16 @@ def record_device_memory() -> None:
                 )
     except Exception:  # noqa: BLE001 — telemetry never takes the host down
         pass
+
+
+def poll_device_memory() -> None:
+    """One device-memory poll tick: sample the gauges, flush if changed.
+
+    The scheduler's per-run loop calls this on a timer
+    (``TIP_OBS_MEMPOLL_S``), so the exported flame chart carries the
+    memory high-water as a counter track that moves over the run instead
+    of a single end-of-phase value. ``flush``'s duplicate suppression
+    keeps an idle poll from writing anything.
+    """
+    record_device_memory()
+    flush()

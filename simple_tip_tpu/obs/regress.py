@@ -1,0 +1,284 @@
+"""Cross-run regression detection over traces and bench records (obs v2).
+
+A 100-run study emits per-phase wall-clock (obs spans), health counters
+(worker deaths, requeues, cache corruption) and bench records — but until
+this module nothing COMPARED one study against the last: BENCH_r05.json
+silently replaced a TPU record with a ``"degraded": true`` CPU one and no
+alarm fired. ``obs regress BASELINE CURRENT`` diffs two snapshots per
+phase/metric and exits nonzero when the current one regressed.
+
+Accepted snapshot forms (auto-detected, mixable):
+
+- an obs run directory (or ``events-*.jsonl`` files): phases are the span
+  table's per-name totals, counters the summed metrics counters;
+- a ``summary --json`` document (``{"spans": ..., "counters": ...}``);
+- a bench record — either ``bench.py``'s raw JSON line or the round
+  driver's ``BENCH_r0*.json`` wrapper (the record under ``"parsed"``).
+
+Regression rules (thresholds configurable from the CLI):
+
+- a phase whose duration grew more than ``max_growth`` (default 25%) over
+  a baseline of at least ``min_seconds`` (noise floor, default 0.05 s);
+- a bench headline value that DROPPED more than ``max_growth`` (throughput
+  metrics: higher is better);
+- any ``degraded`` flip false -> true (the BENCH_r05 failure mode);
+- any growth in a health counter (worker deaths, timeouts, requeues,
+  watchdog failures, cache corruption).
+
+Stdlib-only: this runs in the tier-0 CI gate.
+"""
+
+import json
+import os
+
+#: Counters whose INCREASE between runs is a health regression. Matched as
+#: name prefixes so per-device / per-phase suffixes participate.
+HEALTH_COUNTERS = (
+    "scheduler.worker_deaths",
+    "scheduler.timeouts",
+    "scheduler.requeues",
+    "watchdog.probe_fail",
+    "watchdog.probe_timeout",
+    "sa_fit_cache.corrupt",
+)
+
+#: Default growth threshold (fraction) past which a phase regressed.
+DEFAULT_MAX_GROWTH = 0.25
+
+#: Phases shorter than this (seconds) in the baseline are noise, not signal.
+DEFAULT_MIN_SECONDS = 0.05
+
+
+def _is_health_counter(name: str) -> bool:
+    """Whether counter ``name`` participates in the health comparison."""
+    return any(name.startswith(p) for p in HEALTH_COUNTERS)
+
+
+def _blank_snapshot(kind: str, source: str) -> dict:
+    """A zeroed snapshot skeleton."""
+    return {
+        "kind": kind,
+        "source": source,
+        "phases": {},
+        "counters": {},
+        "degraded": None,
+        "value": None,
+    }
+
+
+def _normalize_bench(doc: dict, source: str) -> dict:
+    """A bench record (raw ``bench.py`` JSON) as a snapshot."""
+    snap = _blank_snapshot("bench", source)
+    try:
+        snap["value"] = float(doc.get("value") or 0)
+    except (TypeError, ValueError):
+        snap["value"] = 0.0
+    snap["degraded"] = bool(doc.get("degraded", False))
+    counters = (doc.get("obs_metrics") or {}).get("counters") or {}
+    snap["counters"] = {
+        k: v for k, v in counters.items() if isinstance(v, (int, float))
+    }
+    sa = doc.get("sa_fit_seconds") or {}
+    for variant, secs in (sa.get("by_variant") or {}).items():
+        if isinstance(secs, (int, float)):
+            snap["phases"][f"sa_fit.{variant}"] = float(secs)
+    if isinstance(sa.get("total"), (int, float)):
+        snap["phases"]["sa_fit.total"] = float(sa["total"])
+    return snap
+
+
+def load_snapshot(target) -> dict:
+    """Normalize ``target`` into ``{kind, phases, counters, degraded, value}``.
+
+    ``target`` is a path: an obs run dir / ``.jsonl`` file (trace mode), or
+    a JSON document (bench record, ``BENCH_r0*.json`` wrapper, or
+    ``summary --json`` output). Raises ``ValueError`` on unrecognizable
+    input — regress must fail loudly, not compare garbage.
+    """
+    snap = _blank_snapshot("trace", str(target))
+    if os.path.isdir(target) or str(target).endswith(".jsonl"):
+        from simple_tip_tpu.obs.cli import (
+            _span_table,
+            _summed_counters,
+            load_events,
+        )
+
+        events, files, _bad = load_events(target)
+        if not files:
+            raise ValueError(f"{target}: no events-*.jsonl streams found")
+        snap["phases"] = {
+            name: round(total, 6)
+            for name, (_cnt, total, _mx) in _span_table(events).items()
+        }
+        snap["counters"] = _summed_counters(events)
+        return snap
+
+    try:
+        with open(target, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        raise ValueError(f"{target}: not a readable JSON document ({e})")
+    if not isinstance(doc, dict):
+        raise ValueError(f"{target}: expected a JSON object")
+    if isinstance(doc.get("parsed"), dict):  # BENCH_r0*.json driver wrapper
+        doc = doc["parsed"]
+
+    if "metric" in doc and "value" in doc:  # bench record
+        return _normalize_bench(doc, str(target))
+
+    if isinstance(doc.get("spans"), dict):  # summary --json document
+        snap["phases"] = {
+            name: float(info.get("total_s", 0) or 0)
+            for name, info in doc["spans"].items()
+            if isinstance(info, dict)
+        }
+        counters = doc.get("counters") or {}
+        snap["counters"] = {
+            k: v for k, v in counters.items() if isinstance(v, (int, float))
+        }
+        return snap
+
+    raise ValueError(
+        f"{target}: unrecognized snapshot (need an obs run dir, a bench "
+        "record / BENCH_r0*.json, or `obs summary --json` output)"
+    )
+
+
+def compare(
+    baseline: dict,
+    current: dict,
+    max_growth: float = DEFAULT_MAX_GROWTH,
+    min_seconds: float = DEFAULT_MIN_SECONDS,
+) -> dict:
+    """Diff two snapshots; returns ``{rows, regressions, ok}``.
+
+    ``rows`` is every compared (kind, name, base, cur, delta) tuple-dict —
+    the printable table; ``regressions`` the failing subset.
+    """
+    rows = []
+
+    def row(kind, name, base, cur, regressed, note=""):
+        delta = None
+        if isinstance(base, (int, float)) and isinstance(cur, (int, float)) and base:
+            delta = (cur - base) / abs(base)
+        rows.append(
+            {
+                "kind": kind,
+                "name": name,
+                "baseline": base,
+                "current": cur,
+                "delta": delta,
+                "regressed": bool(regressed),
+                "note": note,
+            }
+        )
+
+    for name in sorted(set(baseline["phases"]) | set(current["phases"])):
+        base = baseline["phases"].get(name)
+        cur = current["phases"].get(name)
+        if base is None or cur is None:
+            row("phase", name, base, cur, False, "only in one snapshot")
+            continue
+        if base < min_seconds:
+            row("phase", name, base, cur, False, "below noise floor")
+            continue
+        grew = cur > base * (1.0 + max_growth)
+        row(
+            "phase", name, base, cur, grew,
+            f"> +{max_growth:.0%} growth" if grew else "",
+        )
+
+    if baseline["value"] is not None and current["value"] is not None:
+        dropped = (
+            baseline["value"] > 0
+            and current["value"] < baseline["value"] * (1.0 - max_growth)
+        )
+        row(
+            "bench", "value", baseline["value"], current["value"], dropped,
+            f"> -{max_growth:.0%} drop" if dropped else "",
+        )
+
+    if baseline["degraded"] is not None or current["degraded"] is not None:
+        flip = baseline["degraded"] is False and current["degraded"] is True
+        row(
+            "bench", "degraded", baseline["degraded"], current["degraded"],
+            flip, "false -> true flip" if flip else "",
+        )
+
+    for name in sorted(set(baseline["counters"]) | set(current["counters"])):
+        if not _is_health_counter(name):
+            continue
+        base = baseline["counters"].get(name, 0)
+        cur = current["counters"].get(name, 0)
+        row(
+            "counter", name, base, cur, cur > base,
+            "health counter grew" if cur > base else "",
+        )
+
+    regressions = [r for r in rows if r["regressed"]]
+    return {"rows": rows, "regressions": regressions, "ok": not regressions}
+
+
+def render(result: dict, baseline: dict, current: dict) -> str:
+    """The comparison as a deterministic text table."""
+    out = [
+        f"baseline: {baseline['source']} ({baseline['kind']})",
+        f"current:  {current['source']} ({current['kind']})",
+        "",
+        f"  {'kind':<8} {'name':<40} {'baseline':>12} {'current':>12} "
+        f"{'delta':>8}  verdict",
+    ]
+
+    def fmt(v):
+        if isinstance(v, bool) or v is None:
+            return str(v)
+        if isinstance(v, float):
+            return f"{v:.3f}"
+        return str(v)
+
+    for r in result["rows"]:
+        delta = f"{r['delta']:+.0%}" if r["delta"] is not None else "-"
+        verdict = "REGRESSED" if r["regressed"] else "ok"
+        if r["note"] and not r["regressed"]:
+            verdict = f"ok ({r['note']})"
+        elif r["note"]:
+            verdict = f"REGRESSED ({r['note']})"
+        out.append(
+            f"  {r['kind']:<8} {r['name']:<40} {fmt(r['baseline']):>12} "
+            f"{fmt(r['current']):>12} {delta:>8}  {verdict}"
+        )
+    out.append("")
+    n = len(result["regressions"])
+    out.append(
+        "regress OK: no regressions"
+        if not n
+        else f"regress FAILED: {n} regression(s)"
+    )
+    return "\n".join(out)
+
+
+def bench_delta(current_record: dict, previous_path: str) -> dict:
+    """``bench.py`` hook: the current record's delta vs a previous BENCH file.
+
+    Returns a JSON-safe summary to embed in the record (never raises —
+    bench's one-JSON-line contract outranks the companion).
+    """
+    try:
+        baseline = load_snapshot(previous_path)
+        current = _normalize_bench(current_record, "<current run>")
+        result = compare(baseline, current)
+        return {
+            "against": os.path.basename(previous_path),
+            "ok": result["ok"],
+            "regressions": [
+                {k: r[k] for k in ("kind", "name", "baseline", "current", "note")}
+                for r in result["regressions"]
+            ],
+            "value_ratio": (
+                round(current["value"] / baseline["value"], 3)
+                if baseline["value"]
+                else None
+            ),
+        }
+    except Exception as e:  # noqa: BLE001 — companion data, never fatal
+        return {"against": os.path.basename(str(previous_path)), "error": repr(e)[:200]}

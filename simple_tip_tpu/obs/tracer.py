@@ -11,14 +11,36 @@ One event stream per process, under ``TIP_OBS_DIR``:
   appends into the SAME run directory;
 - any other value          -> that directory, verbatim.
 
-Each process owns exactly one file (``events-<pid>-<token>.jsonl``; the
-token keeps restarts from interleaving two boots in one file) and opens it
-lazily on the first real event. The first line is a ``meta`` event stamping
-pid / worker index / platform (``TIP_OBS_WORKER`` / ``TIP_OBS_PLATFORM``,
-set by the scheduler when it spawns workers), which is how the CLI merges
-streams across the spawn boundary. Every write is one ``json.dumps`` line
-plus flush — a crashed process leaves a file whose complete lines all still
-parse (the reader skips at most the torn tail line).
+Each process owns one stream (``events-<pid>-<token>.jsonl``; the token
+keeps restarts from interleaving two boots in one file) opened lazily on
+the first real event. The first line of every file is a ``meta`` event
+stamping pid / worker index / platform (``TIP_OBS_WORKER`` /
+``TIP_OBS_PLATFORM``, set by the scheduler when it spawns workers), which
+is how the CLI merges streams across the spawn boundary. Every write is one
+``json.dumps`` line plus flush — a crashed process leaves a file whose
+complete lines all still parse (the reader skips at most the torn tail
+line).
+
+Trace lifecycle (obs v2) — a 100-run study with per-badge spans would
+otherwise grow GB-class run directories:
+
+- ``TIP_OBS_MAX_BYTES`` caps this process's on-disk footprint (default
+  64 MiB; suffixes ``k``/``m``/``g``; ``0``/``off``/``unlimited`` disables
+  the cap). The stream rotates into fixed-count segments
+  (``events-<pid>-<token>-<seq>.jsonl``, each opening with its own ``meta``
+  stamp); past the cap the OLDEST segment is deleted and an
+  ``obs.evicted`` marker event records how many segments/bytes are gone,
+  so a truncated trace is always self-describing.
+- ``TIP_OBS_SAMPLE`` (``name=N[,name=N...]``) keeps 1-in-N spans of each
+  named hot span (per process, deterministic from the per-name counter);
+  kept spans carry ``sample_1_in: N`` so readers know each one stands for
+  N. Sampled-out spans are full no-ops — their children attach to the
+  nearest kept ancestor. This is what makes per-badge loops instrumentable.
+- ``study_root`` opens a study-level root span and pins its id into
+  ``os.environ["TIP_OBS_ROOT"]`` (the same spawn-boundary trick as the
+  resolved TIP_OBS_DIR): a span opened at stack depth 0 in ANY process of
+  the study — scheduler.phase, a worker's ``run``, an engine phase —
+  parents onto the root, so the merged trace is one tree.
 
 Span semantics: context manager (``with span("fit", variant="dsa"):``) or
 decorator (``@traced()``); nesting is tracked per thread, each span records
@@ -47,11 +69,62 @@ _local = threading.local()
 # inherited the parent's handle and must re-resolve (spawn re-imports anyway).
 _state = None
 
+#: Default per-process on-disk cap (64 MiB). Chosen for 100-run studies:
+#: one scheduler parent + a handful of workers stays comfortably under a
+#: GB even with per-badge spans sampled in; RUNBOOK 5b documents the math.
+DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+
+#: Rotation granularity: the cap is split across this many segments, so
+#: eviction drops at most 1/Nth of the history at a time.
+SEGMENTS = 8
+
+#: Env var carrying the study root span id across the spawn boundary.
+ROOT_ENV = "TIP_OBS_ROOT"
+
+
+def _parse_max_bytes(raw: str):
+    """``TIP_OBS_MAX_BYTES`` -> byte count or None (uncapped)."""
+    raw = (raw or "").strip().lower()
+    if not raw:
+        return DEFAULT_MAX_BYTES
+    if raw in ("0", "off", "unlimited", "none"):
+        return None
+    mult = 1
+    if raw[-1] in "kmg":
+        mult = {"k": 1024, "m": 1024**2, "g": 1024**3}[raw[-1]]
+        raw = raw[:-1]
+    try:
+        n = int(float(raw) * mult)
+    except ValueError:
+        return DEFAULT_MAX_BYTES
+    return n if n > 0 else None
+
+
+def _parse_sample(raw: str) -> dict:
+    """``TIP_OBS_SAMPLE`` (``name=N,name2=M``) -> {span name: keep-1-in-N}."""
+    out = {}
+    for part in (raw or "").split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, _, n = part.rpartition("=")
+        try:
+            n = int(n)
+        except ValueError:
+            continue
+        if name.strip() and n > 1:
+            out[name.strip()] = n
+    return out
+
 
 class _State:
-    """Per-process tracer state: resolved directory, lazy file handle."""
+    """Per-process tracer state: resolved directory, lazy rotating stream."""
 
-    __slots__ = ("enabled", "dir", "path", "fh", "pid", "next_id", "meta_written")
+    __slots__ = (
+        "enabled", "dir", "path", "fh", "pid", "next_id", "meta_written",
+        "token", "seq", "cur_bytes", "segments", "max_bytes", "seg_bytes",
+        "sample", "sample_counts", "evicted_segments", "evicted_bytes",
+    )
 
     def __init__(self, enabled, directory):
         self.enabled = enabled
@@ -61,6 +134,20 @@ class _State:
         self.pid = os.getpid()
         self.next_id = 0
         self.meta_written = False
+        self.token = secrets.token_hex(4) if enabled else ""
+        self.seq = 0
+        self.cur_bytes = 0
+        self.segments = []  # this process's live segment paths, oldest first
+        self.max_bytes = _parse_max_bytes(os.environ.get("TIP_OBS_MAX_BYTES", "")) if enabled else None
+        # Floor keeps a tiny cap from rotating on every line; the cap still
+        # holds because eviction runs on segment COUNT, not byte totals.
+        self.seg_bytes = (
+            max(1024, self.max_bytes // SEGMENTS) if self.max_bytes else None
+        )
+        self.sample = _parse_sample(os.environ.get("TIP_OBS_SAMPLE", "")) if enabled else {}
+        self.sample_counts = {}
+        self.evicted_segments = 0
+        self.evicted_bytes = 0
 
 
 def _resolve():
@@ -147,11 +234,70 @@ def _meta_event() -> dict:
     return rec
 
 
+def _segment_name(st) -> str:
+    """Filename of segment ``st.seq`` (the first keeps the legacy name)."""
+    base = f"events-{st.pid}-{st.token}"
+    return f"{base}.jsonl" if st.seq == 0 else f"{base}-{st.seq:03d}.jsonl"
+
+
+def _open_segment(st) -> None:
+    """Open the current segment file and stamp its ``meta`` head line."""
+    os.makedirs(st.dir, exist_ok=True)
+    st.path = os.path.join(st.dir, _segment_name(st))
+    st.fh = open(st.path, "a", encoding="utf-8")
+    st.segments.append(st.path)
+    st.cur_bytes = 0
+    line = json.dumps(_meta_event(), default=repr) + "\n"
+    st.fh.write(line)
+    st.cur_bytes += len(line.encode("utf-8"))
+    st.meta_written = True
+
+
+def _rotate(st) -> None:
+    """Close the full segment, evict past the cap, open the next one."""
+    try:
+        st.fh.close()
+    except OSError:
+        pass
+    st.fh = None
+    st.seq += 1
+    # Evict oldest segments until the live count fits the cap again. The
+    # about-to-open segment counts toward the budget, hence >= SEGMENTS.
+    while len(st.segments) >= SEGMENTS:
+        victim = st.segments.pop(0)
+        try:
+            st.evicted_bytes += os.path.getsize(victim)
+            os.remove(victim)
+            st.evicted_segments += 1
+        except OSError:
+            break  # cannot evict (already gone / perms): stop trying
+    _open_segment(st)
+    if st.evicted_segments:
+        # Self-describing truncation: the first real line after the meta
+        # stamp says what the retention policy has dropped so far.
+        marker = {
+            "type": "event",
+            "name": "obs.evicted",
+            "ts": time.time(),
+            "pid": st.pid,
+            "tid": threading.get_ident(),
+            "attrs": {
+                "segments": st.evicted_segments,
+                "bytes": st.evicted_bytes,
+                "max_bytes": st.max_bytes,
+            },
+        }
+        line = json.dumps(marker, default=repr) + "\n"
+        st.fh.write(line)
+        st.cur_bytes += len(line.encode("utf-8"))
+
+
 def write(rec: dict) -> None:
     """Append one event line to this process's stream (no-op when disabled).
 
-    Never raises: a full disk or revoked directory degrades telemetry to
-    silence, not the pipeline to failure.
+    Rotates into a fresh segment when the current one would exceed its
+    share of ``TIP_OBS_MAX_BYTES``. Never raises: a full disk or revoked
+    directory degrades telemetry to silence, not the pipeline to failure.
     """
     st = _get_state()
     if not st.enabled:
@@ -159,17 +305,14 @@ def write(rec: dict) -> None:
     with _lock:
         try:
             if st.fh is None:
-                os.makedirs(st.dir, exist_ok=True)
-                st.path = os.path.join(
-                    st.dir,
-                    f"events-{os.getpid()}-{secrets.token_hex(4)}.jsonl",
-                )
-                st.fh = open(st.path, "a", encoding="utf-8")
+                _open_segment(st)
                 atexit.register(_close_at_exit)
-            if not st.meta_written:
-                st.meta_written = True
-                st.fh.write(json.dumps(_meta_event(), default=repr) + "\n")
-            st.fh.write(json.dumps(rec, default=repr) + "\n")
+            line = json.dumps(rec, default=repr) + "\n"
+            nbytes = len(line.encode("utf-8"))
+            if st.seg_bytes is not None and st.cur_bytes + nbytes > st.seg_bytes:
+                _rotate(st)
+            st.fh.write(line)
+            st.cur_bytes += nbytes
             st.fh.flush()
         except OSError:
             # Telemetry must never take the instrumented pipeline down.
@@ -228,8 +371,17 @@ class Span:
         st = _get_state()
         stack = _span_stack()
         self._id = _new_span_id(st)
-        self._parent = stack[-1] if stack else None
-        self._depth = len(stack)
+        if stack:
+            self._parent = stack[-1]
+            self._depth = len(stack)
+        else:
+            # Stack-root span: attach under the study root pinned into the
+            # environment (by study_root, possibly in ANOTHER process — the
+            # spawn boundary inherits os.environ), so scheduler/worker/
+            # engine top spans merge into one study tree.
+            root = os.environ.get(ROOT_ENV, "").strip() or None
+            self._parent = root if root != self._id else None
+            self._depth = 1 if self._parent else 0
         stack.append(self._id)
         self._wall = time.time()
         self._t0 = time.perf_counter()
@@ -261,10 +413,60 @@ class Span:
 
 
 def span(name: str, **attrs):
-    """A context-manager span; the shared no-op when telemetry is disabled."""
+    """A context-manager span; the shared no-op when telemetry is disabled.
+
+    With ``TIP_OBS_SAMPLE`` naming this span, only 1-in-N occurrences are
+    recorded (kept spans carry ``sample_1_in: N``); the rest are full
+    no-ops whose children attach to the nearest kept ancestor.
+    """
+    st = _get_state()
+    if not st.enabled:
+        return _NOOP
+    rate = st.sample.get(name)
+    if rate is not None:
+        with _lock:
+            count = st.sample_counts.get(name, 0)
+            st.sample_counts[name] = count + 1
+        if count % rate:
+            return _NOOP
+        attrs.setdefault("sample_1_in", rate)
+    return Span(name, attrs)
+
+
+class _RootSpan(Span):
+    """The study root span: pins its id into the env for every child process."""
+
+    __slots__ = ("_prev_root",)
+
+    def __enter__(self):
+        self._prev_root = os.environ.get(ROOT_ENV)
+        super().__enter__()
+        os.environ[ROOT_ENV] = self._id
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        # Un-pin only our own id: a crashed inner study must not clear an
+        # outer root's pin.
+        if os.environ.get(ROOT_ENV) == self._id:
+            if self._prev_root is None:
+                os.environ.pop(ROOT_ENV, None)
+            else:
+                os.environ[ROOT_ENV] = self._prev_root
+        return super().__exit__(exc_type, exc_val, exc_tb)
+
+
+def study_root(name: str = "study", **attrs):
+    """Open the study-level root span and export its id to child processes.
+
+    Every span later opened at stack depth 0 — in this process or any
+    spawned child that inherits the environment — parents onto this span,
+    so a whole multi-phase, multi-worker study merges into ONE tree (and
+    one nested Perfetto flame chart). No-op when telemetry is disabled.
+    """
     if not _get_state().enabled:
         return _NOOP
-    return Span(name, attrs)
+    attrs.setdefault("kind", "study_root")
+    return _RootSpan(name, attrs)
 
 
 def traced(name=None, **attrs):
@@ -312,6 +514,10 @@ def record_span(name: str, wall_start: float, dur: float, **attrs) -> None:
     if not st.enabled:
         return
     stack = _span_stack()
+    span_id = _new_span_id(st)
+    parent = stack[-1] if stack else (
+        os.environ.get(ROOT_ENV, "").strip() or None
+    )
     rec = {
         "type": "span",
         "name": name,
@@ -319,11 +525,11 @@ def record_span(name: str, wall_start: float, dur: float, **attrs) -> None:
         "dur": dur,
         "pid": os.getpid(),
         "tid": threading.get_ident(),
-        "id": _new_span_id(st),
-        "depth": len(stack),
+        "id": span_id,
+        "depth": len(stack) if stack else (1 if parent else 0),
     }
-    if stack:
-        rec["parent"] = stack[-1]
+    if parent is not None and parent != span_id:
+        rec["parent"] = parent
     if attrs:
         rec["attrs"] = attrs
     write(rec)

@@ -173,14 +173,17 @@ class DeviceAggregateStatisticsCollector:
         import jax.numpy as jnp
         import time as _time
 
-        t0 = _time.time()
+        # perf_counter, not time.time: duration accounting must survive
+        # NTP steps (repo idiom since the PR 4 timer fix; tiplint
+        # wallclock-duration enforces it).
+        t0 = _time.perf_counter()
         badge = [jnp.asarray(b) for b in badge]
         if self._state is None:
             self._state = self._init_layer(badge)
         else:
             self._state = self._update_layer(self._state, badge)
         jax.block_until_ready([s[0] for s in self._state])
-        self._fused_elapsed += _time.time() - t0
+        self._fused_elapsed += _time.perf_counter() - t0
 
     def get(self) -> AggStats:
         """Return (mins, maxs, stds) per layer (host numpy)."""

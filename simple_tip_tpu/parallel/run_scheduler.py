@@ -59,6 +59,13 @@ logger = logging.getLogger(__name__)
 # at startup: a fresh spawn pays interpreter + jax import (tens of seconds).
 _STARTUP_GRACE_S = 120.0
 
+# Device-memory poll period for the scheduler loop (TIP_OBS_MEMPOLL_S, 0
+# disables): with telemetry on, the per-device peak_bytes_in_use gauges are
+# sampled and flushed on this cadence, so the exported flame chart carries
+# the memory high-water as a moving counter track instead of one
+# end-of-phase value.
+_DEFAULT_MEMPOLL_S = 30.0
+
 # Registered phase runners, by name so the spawn pickling stays trivial.
 # Each maps (case_study_obj, [model_id], kwargs) -> None and must itself be
 # single-process (num_workers forced to 1 inside the worker).
@@ -135,12 +142,35 @@ def _phase_test_wedge(cs, ids, marker_dir=None, wedge_ids=(), always_wedge=False
             f.write(f"{time.time()} {time.time()} {os.getpid()}")
 
 
+def _phase_test_die(cs, ids, marker_dir=None, die_ids=(), **kw):
+    """Scheduler-test phase emulating a worker DEATH (segfault/OOM-kill):
+    the first attempt at a ``die_ids`` id hard-exits the process without
+    reporting; the requeued retry (which sees the attempt marker) completes.
+    """
+    for i in ids:
+        attempt_marker = os.path.join(marker_dir, f"attempt_{i}")
+        first_attempt = not os.path.exists(attempt_marker)
+        with open(attempt_marker, "a") as f:
+            f.write(f"{os.getpid()}\n")
+        if i in set(die_ids) and first_attempt:
+            # Let the queue feeder flush the preceding done_q "start" put
+            # and RELEASE the shared write-lock semaphore before dying:
+            # _exit mid-feeder-write would leave every sibling process
+            # deadlocked on the orphaned lock (an mp.Queue property, not a
+            # scheduler bug — the scheduler only ever reads with timeouts).
+            time.sleep(0.5)
+            os._exit(1)  # no Python teardown: the scheduler sees a dead pid
+        with open(os.path.join(marker_dir, f"run_{i}.txt"), "w") as f:
+            f.write(f"{os.getpid()}")
+
+
 PHASES = {
     "test_prio": _phase_test_prio,
     "active_learning": _phase_active_learning,
     "at_collection": _phase_at_collection,
     "_test_sleep": _phase_test_sleep,
     "_test_wedge": _phase_test_wedge,
+    "_test_die": _phase_test_die,
 }
 
 
@@ -308,9 +338,11 @@ def run_phase_parallel(
     def _handle(msg) -> None:
         kind, model_id, payload = msg
         if kind == "start":
+            # Deadlines ride the monotonic clock: an NTP step mid-run must
+            # not fire (or indefinitely defer) a wedge timeout.
             in_flight[model_id] = {
                 "pid": payload,
-                "deadline": time.time() + run_timeout_s,
+                "deadline": time.monotonic() + run_timeout_s,
             }
             obs.event(
                 "scheduler.start", model_id=model_id, phase=phase,
@@ -335,7 +367,7 @@ def run_phase_parallel(
 
     def _reap_stuck() -> None:
         """Terminate wedged/dead workers holding an id; requeue once to CPU."""
-        now = time.time()
+        now = time.monotonic()
         by_pid = {w.pid: w for w in workers}
         for model_id, info in list(in_flight.items()):
             w = by_pid.get(info["pid"])
@@ -392,20 +424,29 @@ def run_phase_parallel(
     # small test timeout does not misread normal interpreter+jax startup
     # (seconds to tens of seconds) as a wedged pool.
     stall_timeout_s = run_timeout_s + _STARTUP_GRACE_S
-    last_progress = time.time()
+    last_progress = time.monotonic()
     startup_rescued = False
+    mempoll_s = float(os.environ.get("TIP_OBS_MEMPOLL_S", str(_DEFAULT_MEMPOLL_S)))
+    last_mempoll = time.monotonic()
 
     while len(results) < len(model_ids):
+        if (
+            mempoll_s > 0
+            and obs.enabled()
+            and time.monotonic() - last_mempoll >= mempoll_s
+        ):
+            last_mempoll = time.monotonic()
+            obs.poll_device_memory()
         try:
             _handle(done_q.get(timeout=1.0))
-            last_progress = time.time()
+            last_progress = time.monotonic()
             continue
         except queue_mod.Empty:
             pass
         _reap_stuck()
         if in_flight:
-            last_progress = time.time()  # per-id deadlines own this case
-        elif time.time() - last_progress > stall_timeout_s:
+            last_progress = time.monotonic()  # per-id deadlines own this case
+        elif time.monotonic() - last_progress > stall_timeout_s:
             alive = [w for w in workers if w.is_alive()]
             if alive and not startup_rescued:
                 logger.error(
@@ -419,7 +460,7 @@ def run_phase_parallel(
                 startup_rescued = True
                 for _ in range(min(num_workers, len(model_ids) - len(results))):
                     _spawn("cpu")
-                last_progress = time.time()
+                last_progress = time.monotonic()
             elif alive:
                 logger.error(
                     "[%s] %s: CPU replacement pool also made no progress for "
@@ -448,6 +489,9 @@ def run_phase_parallel(
         completed=sum(1 for e in results.values() if e is None),
         failed=sum(1 for e in results.values() if e is not None),
     ).__exit__(None, None, None)
+    # Final high-water sample even for phases shorter than the poll period.
+    if obs.enabled():
+        obs.record_device_memory()
     obs.flush_metrics()
 
     failed = {m: e for m, e in results.items() if e is not None}

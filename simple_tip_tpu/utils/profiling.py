@@ -12,10 +12,17 @@ build layers two optional capture planes over that schema, both driven by
   (viewable in TensorBoard / Perfetto) around the phase.
 
 With neither set, ``maybe_trace`` is a no-op context manager.
+
+When BOTH are set the span carries ``xla_trace_dir`` (where the profiler
+capture went) and ``xla_started_ts`` (the wall-clock instant the profiler
+actually started, after its startup cost) — exactly the attributes
+``obs export --splice-xla`` needs to time-shift the device timeline under
+this host span in one merged Perfetto file (simple_tip_tpu/obs/splice.py).
 """
 
 import contextlib
 import os
+import time
 
 from simple_tip_tpu import obs
 
@@ -28,7 +35,7 @@ def maybe_trace(label: str):
     span_attrs = {"kind": "phase"}
     if profile_dir:
         span_attrs["xla_trace_dir"] = os.path.join(profile_dir, label)
-    with obs.span(label, **span_attrs):
+    with obs.span(label, **span_attrs) as sp:
         if not profile_dir:
             yield
             return
@@ -37,4 +44,7 @@ def maybe_trace(label: str):
         out = os.path.join(profile_dir, label)
         os.makedirs(out, exist_ok=True)
         with jax.profiler.trace(out):
+            # Stamped INSIDE the profiler context: the splice anchors the
+            # device timeline here, past the profiler's own startup cost.
+            sp.set(xla_started_ts=time.time())
             yield

@@ -16,8 +16,11 @@ import sys
 # A TIP_OBS_DIR inherited from the developer's shell would make every test
 # process stream telemetry into one real run directory (and perturb the
 # no-op overhead pin); tests that need telemetry enable it per-test via
-# monkeypatch + obs.reset_all().
-os.environ.pop("TIP_OBS_DIR", None)
+# monkeypatch + obs.reset_all(). Same for an inherited study-root pin and
+# the v2 lifecycle knobs, which would silently re-parent / sample / rotate
+# every span the suite writes.
+for _var in ("TIP_OBS_DIR", "TIP_OBS_ROOT", "TIP_OBS_SAMPLE", "TIP_OBS_MAX_BYTES"):
+    os.environ.pop(_var, None)
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

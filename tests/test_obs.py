@@ -13,7 +13,9 @@ Pinned here, per the subsystem's three promises:
    schema, and the ``check`` self-check including torn-tail tolerance.
 """
 
+import gzip
 import json
+import logging
 import os
 import subprocess
 import sys
@@ -26,6 +28,7 @@ from simple_tip_tpu.obs.cli import check, load_events, main, to_chrome_trace
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURE = os.path.join(REPO_ROOT, "tests", "fixtures", "obs_trace")
+REGRESS_FIXTURE = os.path.join(REPO_ROOT, "tests", "fixtures", "obs_regress")
 
 
 @pytest.fixture
@@ -166,23 +169,54 @@ def test_cross_process_merge_two_writers(obs_dir, monkeypatch):
     assert len(metas) == 2 and all(m.get("worker") == "w" for m in metas)
 
 
-def test_scheduler_run_produces_merged_inspectable_trace(obs_dir, tmp_path):
-    """The acceptance shape: a >=2-worker scheduler phase with TIP_OBS_DIR
-    set yields worker-stamped streams that merge into per-run lifecycle
-    rows, worker 'run' spans, and a valid Chrome trace."""
+def test_scheduler_run_produces_merged_inspectable_trace(
+    obs_dir, tmp_path, monkeypatch
+):
+    """The acceptance shape: a study root span + a >=2-worker scheduler
+    phase with TIP_OBS_DIR set yields worker-stamped streams (held under
+    TIP_OBS_MAX_BYTES) that merge into per-run lifecycle rows, worker
+    'run' spans all nested under the SINGLE root, and ONE spliced Perfetto
+    file carrying the XLA device timeline under its host span."""
     from simple_tip_tpu.obs.cli import _scheduler_runs
     from simple_tip_tpu.parallel.run_scheduler import run_phase_parallel
 
+    monkeypatch.setenv("TIP_OBS_MAX_BYTES", "2000000")
+    obs.reset_all()
     marker = tmp_path / "markers"
     marker.mkdir()
-    run_phase_parallel(
-        "mnist",  # registry name; the sleep phase never touches its data
-        "_test_sleep",
-        model_ids=[0, 1, 2],
-        num_workers=2,
-        phase_kwargs={"seconds": 0.1, "marker_dir": str(marker)},
-        worker_platforms=["cpu", "cpu"],
-    )
+    # Synthetic profiler capture in the TensorBoard layout (what
+    # jax.profiler.trace writes), so the splice runs on a real .gz file.
+    xla_dir = tmp_path / "xla" / "device_phase"
+    cap = xla_dir / "plugins" / "profile" / "000"
+    cap.mkdir(parents=True)
+    with gzip.open(cap / "host.trace.json.gz", "wt") as f:
+        json.dump(
+            {
+                "traceEvents": [
+                    {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+                     "args": {"name": "/device:TPU:0"}},
+                    {"ph": "X", "name": "fusion.1", "pid": 1, "tid": 1,
+                     "ts": 5000.0, "dur": 300.0, "args": {}},
+                ]
+            },
+            f,
+        )
+    with obs.study_root("mini_study", runs=3, workers=2):
+        run_phase_parallel(
+            "mnist",  # registry name; the sleep phase never touches its data
+            "_test_sleep",
+            model_ids=[0, 1, 2],
+            num_workers=2,
+            phase_kwargs={"seconds": 0.1, "marker_dir": str(marker)},
+            worker_platforms=["cpu", "cpu"],
+        )
+        with obs.span(
+            "device_phase",
+            kind="phase",
+            xla_trace_dir=str(xla_dir),
+            xla_started_ts=time.time(),
+        ):
+            pass
     events = _events(obs_dir)
     metas = [e for e in events if e["type"] == "meta"]
     workers = {m.get("worker") for m in metas if "worker" in m}
@@ -204,6 +238,385 @@ def test_scheduler_run_produces_merged_inspectable_trace(obs_dir, tmp_path):
     problems = check(*load_events(str(obs_dir)))
     assert not problems, problems
     assert to_chrome_trace(events)["traceEvents"]
+    # Study-root nesting: every span — scheduler.phase in the parent, the
+    # workers' 'run' spans across the spawn boundary, the device phase —
+    # chains up to the ONE root span.
+    root_span = next(
+        e for e in events if e["type"] == "span" and e["name"] == "mini_study"
+    )
+    assert _span_tree_roots(events) == {root_span["id"]}
+    assert all(r["parent"] == root_span["id"] for r in run_spans)
+    # One spliced Perfetto file: host spans + the shifted device timeline.
+    out = tmp_path / "spliced.json"
+    assert main(["export", str(obs_dir), "--splice-xla", "-o", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    names = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert any(n.startswith("xla:device_phase") for n in names), names
+    assert any(e.get("name") == "fusion.1" for e in doc["traceEvents"])
+    # Retention held: the whole run dir stayed under the cap.
+    total = sum(
+        os.path.getsize(os.path.join(obs_dir, f))
+        for f in os.listdir(obs_dir)
+        if f.endswith(".jsonl")
+    )
+    assert total <= 2000000
+
+
+# --- trace lifecycle (obs v2) ------------------------------------------------
+
+
+def test_rotating_writer_holds_directory_under_cap_and_marks_eviction(
+    tmp_path, monkeypatch
+):
+    """TIP_OBS_MAX_BYTES: segments rotate, the oldest is evicted, the
+    directory stays under the cap, and the truncation is self-describing
+    (an ``obs.evicted`` marker) while every surviving segment still passes
+    the schema check (meta-stamped head line)."""
+    d = tmp_path / "capped"
+    monkeypatch.setenv("TIP_OBS_DIR", str(d))
+    monkeypatch.setenv("TIP_OBS_MAX_BYTES", "20000")
+    obs.reset_all()
+    try:
+        for i in range(2000):
+            with obs.span("badge", idx=i, pad="x" * 40):
+                pass
+        obs.reset()
+        files = [f for f in os.listdir(d) if f.endswith(".jsonl")]
+        total = sum(os.path.getsize(d / f) for f in files)
+        assert total <= 20000, f"directory {total}b exceeds the 20000b cap"
+        assert len(files) > 1, "the cap must force rotation into segments"
+        events, fls, bad = load_events(str(d))
+        assert not check(events, fls, bad)
+        evicted = [e for e in events if e.get("name") == "obs.evicted"]
+        assert evicted, "eviction must leave a marker event"
+        attrs = evicted[-1]["attrs"]
+        assert attrs["segments"] > 0 and attrs["bytes"] > 0
+        assert attrs["max_bytes"] == 20000
+    finally:
+        obs.reset_all()
+
+
+def test_max_bytes_parsing_suffixes_and_off():
+    from simple_tip_tpu.obs.tracer import DEFAULT_MAX_BYTES, _parse_max_bytes
+
+    assert _parse_max_bytes("") == DEFAULT_MAX_BYTES
+    assert _parse_max_bytes("64m") == 64 * 1024 * 1024
+    assert _parse_max_bytes("4K") == 4096  # case-insensitive
+    assert _parse_max_bytes("4k") == 4096
+    assert _parse_max_bytes("1g") == 1024**3
+    assert _parse_max_bytes("12345") == 12345
+    for off in ("0", "off", "unlimited"):
+        assert _parse_max_bytes(off) is None
+    assert _parse_max_bytes("not-a-number") == DEFAULT_MAX_BYTES
+
+
+def test_span_sampling_keeps_one_in_n(tmp_path, monkeypatch):
+    """TIP_OBS_SAMPLE=name=N records every Nth occurrence of that span
+    (stamped ``sample_1_in``), leaves other names untouched, and a
+    sampled-out parent re-parents its children to the kept ancestor."""
+    d = tmp_path / "sampled"
+    monkeypatch.setenv("TIP_OBS_DIR", str(d))
+    monkeypatch.setenv("TIP_OBS_SAMPLE", "hot=10")
+    obs.reset_all()
+    try:
+        with obs.span("phase"):
+            for i in range(100):
+                with obs.span("hot", idx=i):
+                    with obs.span("child"):
+                        pass
+        events = _events(d)
+        hot = [e for e in events if e["type"] == "span" and e["name"] == "hot"]
+        assert len(hot) == 10
+        assert [h["attrs"]["idx"] for h in hot] == list(range(0, 100, 10))
+        assert all(h["attrs"]["sample_1_in"] == 10 for h in hot)
+        children = [
+            e for e in events if e["type"] == "span" and e["name"] == "child"
+        ]
+        assert len(children) == 100, "only the NAMED span is sampled"
+        phase_id = next(
+            e["id"] for e in events if e["type"] == "span" and e["name"] == "phase"
+        )
+        hot_ids = {h["id"] for h in hot}
+        # Children under a kept 'hot' parent keep it; the rest climb to
+        # the phase span instead of dangling.
+        assert {c["parent"] for c in children} <= hot_ids | {phase_id}
+        assert sum(1 for c in children if c["parent"] in hot_ids) == 10
+    finally:
+        obs.reset_all()
+
+
+# --- study root span ---------------------------------------------------------
+
+
+def _span_tree_roots(events):
+    """Map every span to the root of its parent chain; return root ids."""
+    spans = {e["id"]: e for e in events if e["type"] == "span"}
+
+    def chase(e):
+        seen = set()
+        while e.get("parent") and e["parent"] in spans and e["id"] not in seen:
+            seen.add(e["id"])
+            e = spans[e["parent"]]
+        return e["id"]
+
+    return {chase(e) for e in spans.values()}
+
+
+def test_study_root_pins_env_and_unpins_on_exit(obs_dir):
+    assert "TIP_OBS_ROOT" not in os.environ
+    with obs.study_root("study", runs=2) as root:
+        assert os.environ["TIP_OBS_ROOT"] == root._id
+        with obs.span("phase"):
+            pass
+    assert "TIP_OBS_ROOT" not in os.environ
+    spans = {e["name"]: e for e in _events(obs_dir) if e["type"] == "span"}
+    assert spans["phase"]["parent"] == spans["study"]["id"]
+    assert spans["study"]["attrs"]["kind"] == "study_root"
+    assert len(_span_tree_roots(_events(obs_dir))) == 1
+
+
+# --- xla splice (unit) -------------------------------------------------------
+
+
+def test_splice_shifts_clock_and_remaps_pids(tmp_path):
+    from simple_tip_tpu.obs.splice import XLA_PID_BASE, splice
+
+    trace_dir = tmp_path / "prof"
+    cap = trace_dir / "plugins" / "profile" / "000"
+    cap.mkdir(parents=True)
+    with open(cap / "host.trace.json", "w") as f:
+        json.dump(
+            {
+                "traceEvents": [
+                    {"ph": "M", "name": "process_name", "pid": 7, "tid": 0,
+                     "args": {"name": "/device:TPU:0"}},
+                    {"ph": "X", "name": "k1", "pid": 7, "tid": 1,
+                     "ts": 1000.0, "dur": 50.0},
+                    {"ph": "X", "name": "k2", "pid": 7, "tid": 1,
+                     "ts": 1100.0, "dur": 25.0},
+                ]
+            },
+            f,
+        )
+    t0 = 100.0
+    host_events = [
+        {"type": "span", "name": "phase", "ts": 101.0, "dur": 1.0, "pid": 42,
+         "tid": 1, "id": "42:1", "depth": 0,
+         "attrs": {"xla_trace_dir": str(trace_dir), "xla_started_ts": 101.25}},
+    ]
+    spliced, report = splice(host_events, t0)
+    assert any("spliced" in line for line in report)
+    k1 = next(e for e in spliced if e.get("name") == "k1")
+    k2 = next(e for e in spliced if e.get("name") == "k2")
+    # Earliest device event lands exactly on xla_started_ts (1.25s -> us).
+    assert k1["ts"] == 1_250_000
+    assert k2["ts"] == 1_250_000 + 100  # relative spacing preserved
+    assert k1["pid"] >= XLA_PID_BASE
+    meta = next(e for e in spliced if e["ph"] == "M")
+    assert meta["args"]["name"] == "xla:phase · /device:TPU:0"
+    assert meta["pid"] == k1["pid"]
+
+
+def test_splice_skips_missing_and_torn_captures(tmp_path):
+    from simple_tip_tpu.obs.splice import splice
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    torn_dir = tmp_path / "torn"
+    torn_dir.mkdir()
+    (torn_dir / "x.trace.json").write_text("{not json")
+    host_events = [
+        {"type": "span", "name": "a", "ts": 1.0, "dur": 1.0, "pid": 1,
+         "tid": 1, "id": "1:1", "depth": 0,
+         "attrs": {"xla_trace_dir": str(empty)}},
+        {"type": "span", "name": "b", "ts": 2.0, "dur": 1.0, "pid": 1,
+         "tid": 1, "id": "1:2", "depth": 0,
+         "attrs": {"xla_trace_dir": str(torn_dir)}},
+        {"type": "span", "name": "c", "ts": 3.0, "dur": 1.0, "pid": 1,
+         "tid": 1, "id": "1:3", "depth": 0,
+         "attrs": {"xla_trace_dir": str(tmp_path / "nonexistent")}},
+    ]
+    spliced, report = splice(host_events, 0.0)
+    assert spliced == []
+    assert len(report) == 2  # empty dir + torn file; missing dir not a span match
+
+
+# --- regress -----------------------------------------------------------------
+
+
+def test_regress_cli_zero_on_identical_inputs(capsys):
+    assert main(["regress", os.path.join(REGRESS_FIXTURE, "base"),
+                 os.path.join(REGRESS_FIXTURE, "base")]) == 0
+    assert "regress OK" in capsys.readouterr().out
+
+
+def test_regress_cli_nonzero_on_phase_slowdown(capsys):
+    """The committed fixture pair carries a synthetic 2x test_prio
+    slowdown plus a worker-death counter bump: both must be caught."""
+    rc = main(["regress", os.path.join(REGRESS_FIXTURE, "base"),
+               os.path.join(REGRESS_FIXTURE, "slow")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "test_prio" in out and "REGRESSED" in out
+    assert "scheduler.worker_deaths" in out
+
+
+def test_regress_cli_nonzero_on_degraded_flip(capsys):
+    rc = main(["regress", os.path.join(REGRESS_FIXTURE, "bench_base.json"),
+               os.path.join(REGRESS_FIXTURE, "bench_degraded.json")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "false -> true flip" in out
+
+
+def test_regress_threshold_is_configurable():
+    base = os.path.join(REGRESS_FIXTURE, "base")
+    slow = os.path.join(REGRESS_FIXTURE, "slow")
+    # With a 3x allowance the 2x slowdown passes, but the health-counter
+    # growth still fails the run: thresholds only govern durations.
+    rc = main(["regress", base, slow, "--max-growth", "2.0"])
+    assert rc == 1
+    from simple_tip_tpu.obs.regress import compare, load_snapshot
+
+    result = compare(load_snapshot(base), load_snapshot(slow), max_growth=2.0)
+    assert not any(
+        r["kind"] == "phase" and r["regressed"] for r in result["rows"]
+    )
+
+
+def test_regress_rejects_garbage_input(tmp_path, capsys):
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"neither": "bench nor summary"}')
+    rc = main(["regress", str(bad), str(bad)])
+    assert rc == 2
+    assert "unrecognized snapshot" in capsys.readouterr().err
+
+
+def test_regress_against_bench_wrapper_formats():
+    """BENCH_r0*.json driver wrappers (record under 'parsed') normalize."""
+    from simple_tip_tpu.obs.regress import load_snapshot
+
+    snap = load_snapshot(os.path.join(REPO_ROOT, "BENCH_r05.json"))
+    assert snap["kind"] == "bench"
+    assert snap["degraded"] is True
+    assert snap["value"] > 0
+
+
+def test_bench_delta_embeds_regressions():
+    from simple_tip_tpu.obs.regress import bench_delta
+
+    current = {
+        "metric": "prioritizer_inputs_per_sec_per_chip",
+        "value": 500.0,
+        "degraded": True,
+        "obs_metrics": {"counters": {}},
+    }
+    delta = bench_delta(
+        current, os.path.join(REGRESS_FIXTURE, "bench_base.json")
+    )
+    assert delta["against"] == "bench_base.json"
+    assert delta["ok"] is False
+    names = {r["name"] for r in delta["regressions"]}
+    assert {"value", "degraded"} <= names
+    assert delta["value_ratio"] == round(500.0 / 3185903.4, 3)
+    # And the hook NEVER raises on garbage baselines.
+    assert "error" in bench_delta(current, "/nonexistent/BENCH_r99.json")
+
+
+# --- summary v2 --------------------------------------------------------------
+
+
+def test_summary_prints_utc_iso_start_times(capsys):
+    assert main(["summary", FIXTURE]) == 0
+    out = capsys.readouterr().out
+    assert "start: 2023-11-14T22:13:20.000Z" in out
+    assert "2023-11-14T22:13:20.100Z" in out  # per-run start column
+
+
+def test_summary_phase_filter(capsys):
+    """--phase keeps the named phase's spans/events (by span name or
+    attrs.phase) and drops the rest of the tables."""
+    assert main(["summary", FIXTURE, "--phase", "test_prio"]) == 0
+    out = capsys.readouterr().out
+    assert "scheduler.phase" in out  # attrs.phase == test_prio
+    assert "run" in out
+    assert "coverage.cam" not in out  # different phase: filtered away
+    assert "sa_fit" not in out
+
+
+def test_metrics_flush_suppresses_identical_snapshots(obs_dir):
+    obs.counter("c").inc()
+    obs.flush_metrics()
+    obs.flush_metrics()  # unchanged registry: no second event
+    assert len([e for e in _events(obs_dir) if e["type"] == "metrics"]) == 1
+    obs.counter("c").inc()
+    obs.flush_metrics()
+    assert len([e for e in _events(obs_dir) if e["type"] == "metrics"]) == 2
+
+
+# --- log bridge under scheduler requeue --------------------------------------
+
+
+def test_logbridge_no_dangling_handler_after_worker_death(obs_dir, tmp_path):
+    """A worker dying mid-run (scheduler requeue path) must not leave the
+    PARENT logger with a doubled/leaked bridge handler: install is
+    idempotent by root-logger inspection, not only by module flag, and a
+    post-phase record lands in the stream exactly once."""
+    import simple_tip_tpu.obs.logbridge as logbridge
+    from simple_tip_tpu.obs.logbridge import ObsLogHandler
+    from simple_tip_tpu.parallel.run_scheduler import run_phase_parallel
+
+    root = logging.getLogger()
+    before = list(root.handlers)
+    marker = tmp_path / "markers"
+    marker.mkdir()
+    try:
+        obs.install_worker_logging()
+        # Re-install (the requeue/restart path re-enters bootstrap code):
+        # the bridge must notice it is already on the root logger even
+        # after the module flag is lost (fresh import state).
+        logbridge.reset()
+        obs.install_worker_logging()
+        n_bridges = sum(
+            1 for h in root.handlers if isinstance(h, ObsLogHandler)
+        )
+        assert n_bridges == 1, "double install must not stack bridge handlers"
+        # One worker keeps the test cheap (worker spawns pay a jax import
+        # each): it completes id 0, dies on its first attempt at id 1, and
+        # the scheduler requeues id 1 onto a fresh CPU replacement.
+        run_phase_parallel(
+            "mnist",
+            "_test_die",
+            model_ids=[0, 1],
+            num_workers=1,
+            phase_kwargs={"marker_dir": str(marker), "die_ids": (1,)},
+            worker_platforms=["cpu"],
+            run_timeout_s=300,
+        )
+        assert sum(
+            1 for h in root.handlers if isinstance(h, ObsLogHandler)
+        ) == 1, "worker death/requeue leaked a bridge handler on the parent"
+        logging.getLogger("simple_tip_tpu.test").info("post-requeue record")
+    finally:
+        root.handlers[:] = before
+        logbridge.reset()
+    events = _events(obs_dir)
+    hits = [
+        e for e in events
+        if e["type"] == "log" and e["msg"] == "post-requeue record"
+    ]
+    assert len(hits) == 1, f"expected exactly one log event, got {len(hits)}"
+    # The death itself was observed and requeued: both ids completed.
+    assert (marker / "run_0.txt").exists() and (marker / "run_1.txt").exists()
+    deaths = [
+        e for e in events
+        if e["type"] == "event" and e["name"] == "scheduler.requeue"
+    ]
+    assert deaths, "the dead worker's id must have been requeued"
 
 
 # --- zero cost when off ------------------------------------------------------

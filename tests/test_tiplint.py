@@ -539,9 +539,64 @@ def test_noise():
 ''',
 }
 
+BAD_WALLCLOCK = {
+    "engine/timing.py": '''"""m."""
+import time
+
+
+def measure(fn):
+    """d."""
+    t0 = time.time()
+    fn()
+    return time.time() - t0
+''',
+    "engine/timing_from_import.py": '''"""m."""
+from time import time as now
+
+
+def measure(fn):
+    """d."""
+    t0 = now()
+    fn()
+    return now() - t0
+''',
+}
+
+GOOD_WALLCLOCK = {
+    "engine/timing.py": '''"""m."""
+import time
+
+
+def measure(fn):
+    """perf_counter subtraction is the duration idiom."""
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def stamp():
+    """time.time() as a TIMESTAMP (no subtraction) is correct."""
+    return {"ts": time.time()}
+
+
+def deadline(budget):
+    """Monotonic deadlines; addition of wall clock is not a duration."""
+    return time.monotonic() + budget
+''',
+    # The scripts/ tree is exempt: wall-clock phase prints are its
+    # interface and cross-process timestamps get subtracted legitimately.
+    "scripts/study.py": '''"""m."""
+import time
+
+t0 = time.time()
+print(time.time() - t0)
+''',
+}
+
 FIXTURES = {
     "jit-purity": (BAD_JIT_PURITY, GOOD_JIT_PURITY),
     "bare-print": (BAD_BARE_PRINT, GOOD_BARE_PRINT),
+    "wallclock-duration": (BAD_WALLCLOCK, GOOD_WALLCLOCK),
     "prng-hygiene": (BAD_PRNG, GOOD_PRNG),
     "host-sync": (BAD_HOST_SYNC, GOOD_HOST_SYNC),
     "f64-on-tpu": (BAD_F64, GOOD_F64),
@@ -581,6 +636,13 @@ def test_jit_purity_finds_each_sin(tmp_path):
     blob = " ".join(f.message for f in findings)
     for marker in ("print()", "numpy.square", "float()", ".item()", "jax.debug.print"):
         assert marker in blob, f"missing {marker!r} in: {blob}"
+
+
+def test_wallclock_duration_catches_both_import_forms(tmp_path):
+    findings = _run_rule(tmp_path, "wallclock-duration", BAD_WALLCLOCK)
+    paths = {f.path for f in findings}
+    assert paths == {"engine/timing.py", "engine/timing_from_import.py"}
+    assert all("perf_counter" in f.message for f in findings)
 
 
 def test_prng_loop_reuse_detected(tmp_path):
