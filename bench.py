@@ -66,16 +66,19 @@ def _load_baseline():
         os.path.dirname(os.path.abspath(__file__)), "BASELINE_MEASURED.json"
     )
     try:
-        with open(path) as f:
-            rec = json.load(f)
+        # Retried bus read (resilience/retry.py `bus` scope); any failure
+        # degrades to the estimate — never let a corrupt baseline file
+        # kill the bench: the outage-proof contract is ONE JSON line
+        # under every condition.
+        from simple_tip_tpu.utils.artifacts_io import load_json
+
+        rec = load_json(path)
         if isinstance(rec, dict):
             rate = float(rec.get("inputs_per_sec", 0))
             if rate > 0:
                 rec.setdefault("source", "scripts/measure_reference_baseline.py")
                 return rate, rec
-    except (OSError, ValueError, TypeError):
-        # never let a corrupt baseline file kill the bench: the outage-proof
-        # contract is ONE JSON line under every condition
+    except (ValueError, TypeError, ImportError):
         pass
     return REFERENCE_ESTIMATE_INPUTS_PER_SEC, {
         "inputs_per_sec": REFERENCE_ESTIMATE_INPUTS_PER_SEC,
@@ -103,7 +106,11 @@ def _child_measure() -> None:
 
     from simple_tip_tpu import obs
     from simple_tip_tpu.config import enable_compilation_cache
-    from simple_tip_tpu.utils.device_watchdog import ensure_responsive_backend
+    from simple_tip_tpu.resilience import CircuitBreaker
+    from simple_tip_tpu.utils.device_watchdog import (
+        degradation_reason,
+        ensure_responsive_backend,
+    )
 
     enable_compilation_cache()
     obs.install_jax_hooks()
@@ -111,6 +118,13 @@ def _child_measure() -> None:
         timeout_s=float(os.environ.get("TIP_BENCH_PROBE_TIMEOUT_S", "75"))
     )
     on_cpu = platform == "cpu"
+    # Degraded-record contract (RUNBOOK §7): WHY the record is degraded
+    # (probe-timeout / probe-fail / breaker-open) travels with the record,
+    # and the breaker snapshot makes an open-circuit run self-describing —
+    # `obs regress` fails a degraded flip against a healthy baseline, so
+    # the silent BENCH_r05 CPU fallback cannot recur.
+    breaker = CircuitBreaker.from_env()
+    breaker_info = breaker.snapshot() if breaker is not None else None
 
     from simple_tip_tpu.models import MnistConvNet
     from simple_tip_tpu.models.train import init_params
@@ -315,6 +329,12 @@ def _child_measure() -> None:
                     else {}
                 ),
                 "degraded": bool(on_cpu),
+                **(
+                    {"degraded_reason": degradation_reason()}
+                    if degradation_reason()
+                    else {}
+                ),
+                **({"breaker": breaker_info} if breaker_info is not None else {}),
                 "obs_overhead_seconds": round(obs_overhead, 6),
                 "obs_enabled": obs.enabled(),
                 "obs_metrics": obs.metrics_snapshot(),
@@ -347,9 +367,12 @@ def _load_last_good_tpu(path=None):
             os.path.dirname(os.path.abspath(__file__)), "bench_tpu.json"
         )
     try:
-        with open(path) as f:
-            rec = json.load(f)
-    except (OSError, ValueError):
+        from simple_tip_tpu.utils.artifacts_io import load_json
+
+        rec = load_json(path)  # retried bus read; None on missing/corrupt
+    except ImportError:  # pragma: no cover — bare checkout
+        return None
+    if rec is None:
         return None
     try:
         if (
@@ -430,6 +453,7 @@ def main():
             "vs_baseline": 0.0,
             "baseline": BASELINE_INFO,
             "degraded": True,
+            "degraded_reason": "all-attempts-failed",
             "mfu": 0.0,
             "error": "all measurement attempts failed or timed out",
         }

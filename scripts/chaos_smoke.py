@@ -1,0 +1,149 @@
+"""Dependency-free chaos smoke: kill+wedge a real 2-worker scheduler phase,
+then prove journaled resume completes it (CI, stdlib-only).
+
+The committed unit tests pin each resilience piece; this script proves the
+COMPOSITION with real spawned worker processes and zero third-party
+dependencies, so the same lint.yml job that runs the analyzer can run it —
+no jax, no numpy, no pip install (the scheduler's synthetic ``_test_*``
+phases never construct a case study or touch a backend):
+
+1. phase 1 runs ``_test_fault`` for 4 runs over 2 CPU workers under a
+   fault plan that hard-kills the worker on run 1's first attempt
+   (requeued, completes) and wedges EVERY attempt at run 2 (requeued,
+   wedges again, fails after the retry budget) — the phase ends with 3/4
+   journaled and a RuntimeError naming run 2;
+2. phase 2 re-runs the SAME invocation with the faults cleared — the
+   restarted scheduler must skip the 3 journaled runs (no new attempts)
+   and complete only run 2.
+
+Exit 0 when every assertion holds; nonzero (with a reason) otherwise.
+
+Usage: python scripts/chaos_smoke.py [--keep]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RUN_IDS = [0, 1, 2, 3]
+DIE_ID, WEDGE_ID = 1, 2
+
+
+def _attempts(marker_dir: str, i: int) -> int:
+    """How many worker attempts touched run ``i`` (0 when none did)."""
+    try:
+        with open(os.path.join(marker_dir, f"attempt_{i}")) as f:
+            return len(f.read().split())
+    except OSError:
+        return 0
+
+
+def main() -> int:
+    """Run the two-phase chaos scenario; return the process exit code."""
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keep", action="store_true", help="keep the temp assets dir")
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="tip_chaos_")
+    os.environ["TIP_ASSETS"] = tmp
+    os.environ["TIP_OBS_DIR"] = os.path.join(tmp, "obs")
+    marker = os.path.join(tmp, "markers")
+    os.makedirs(marker)
+
+    from simple_tip_tpu.parallel.run_scheduler import run_phase_parallel
+
+    plan = {
+        "faults": [
+            {"site": "worker.run", "kind": "die",
+             "match": {"model_id": [DIE_ID]}, "times": 1, "delay_s": 0.5},
+            {"site": "worker.run", "kind": "wedge",
+             "match": {"model_id": [WEDGE_ID]}, "times": 0, "wedge_s": 600},
+        ]
+    }
+
+    failures = []
+
+    def check(ok, what):
+        print(("ok  " if ok else "FAIL") + f" {what}")
+        if not ok:
+            failures.append(what)
+
+    t0 = time.monotonic()
+    phase1_error = ""
+    try:
+        run_phase_parallel(
+            "chaos", "_test_fault", RUN_IDS, num_workers=2,
+            phase_kwargs={"marker_dir": marker, "plan": plan},
+            worker_platforms=["cpu", "cpu"], run_timeout_s=4.0,
+        )
+    except RuntimeError as e:
+        phase1_error = str(e)
+    print(f"phase 1 wall-clock: {time.monotonic() - t0:.1f}s")
+    check(f"run {WEDGE_ID}" in phase1_error, "phase 1 fails naming the wedged run")
+    check(_attempts(marker, DIE_ID) == 2, "killed run was requeued and completed")
+    check(_attempts(marker, WEDGE_ID) == 2, "wedged run burned its retry budget")
+
+    journal_path = os.path.join(tmp, "journal", "runs.jsonl")
+    done = set()
+    try:
+        with open(journal_path) as f:
+            done = {json.loads(line)["model_id"] for line in f if line.strip()}
+    except OSError:
+        pass
+    expect = set(RUN_IDS) - {WEDGE_ID}
+    check(done == expect, f"journal holds exactly the completed runs {sorted(expect)}")
+
+    before = {i: _attempts(marker, i) for i in RUN_IDS}
+    t0 = time.monotonic()
+    try:
+        run_phase_parallel(
+            "chaos", "_test_fault", RUN_IDS, num_workers=2,
+            phase_kwargs={"marker_dir": marker, "plan": {"faults": []}},
+            worker_platforms=["cpu", "cpu"], run_timeout_s=4.0,
+        )
+        resumed_ok = True
+    except RuntimeError as e:
+        resumed_ok = False
+        print(f"resume raised: {e}", file=sys.stderr)
+    print(f"phase 2 (resume) wall-clock: {time.monotonic() - t0:.1f}s")
+    check(resumed_ok, "restarted phase completes")
+    for i in sorted(expect):
+        check(
+            _attempts(marker, i) == before[i],
+            f"journaled run {i} was skipped (no new attempt)",
+        )
+    check(
+        _attempts(marker, WEDGE_ID) == before[WEDGE_ID] + 1,
+        "only the unfinished run re-ran",
+    )
+
+    # The obs stream must carry the lifecycle: injected faults from the
+    # workers, skip events from the resumed scheduler.
+    blob = ""
+    obs_dir = os.environ["TIP_OBS_DIR"]
+    for name in sorted(os.listdir(obs_dir)):
+        if name.startswith("events-") and name.endswith(".jsonl"):
+            with open(os.path.join(obs_dir, name), encoding="utf-8") as f:
+                blob += f.read()
+    check("fault.injected" in blob, "fault injections visible in the obs stream")
+    check("scheduler.skip_journaled" in blob, "journal skips visible in the obs stream")
+    check("scheduler.requeue" in blob, "requeues visible in the obs stream")
+
+    if not args.keep:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if failures:
+        print(f"chaos smoke FAILED: {len(failures)} assertion(s)", file=sys.stderr)
+        return 1
+    print("chaos smoke OK: kill+wedge handled, journaled resume completed the phase")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,7 @@ from simple_tip_tpu.analysis.rules import (  # noqa: F401
     f64_on_tpu,
     host_sync,
     jit_purity,
+    naked_retry,
     prng_hygiene,
     shape_poly,
     sharding_spec,

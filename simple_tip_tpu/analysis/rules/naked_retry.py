@@ -1,0 +1,146 @@
+"""Rule ``naked-retry``: a ``time.sleep`` poll/retry loop with no exit
+budget spins forever on a wedged dependency.
+
+The documented failure mode of this deployment is a tunnel that WEDGES —
+calls hang rather than error — so any ``while ...: time.sleep(...)`` loop
+in library code whose condition can simply never become true (a probe that
+never answers, a file that never appears) turns into the hang the
+watchdog/scheduler machinery exists to prevent. The repo idiom is
+``resilience/retry.py``: bounded attempts, exponential backoff and a
+``time.monotonic`` deadline. This rule flags the loops that predate (or
+bypass) it.
+
+Detected: a ``while`` loop in library code that calls ``time.sleep``
+(module-alias and ``from time import sleep`` forms) and shows NEITHER of
+the two escape hatches:
+
+- a **deadline**: a ``time.monotonic()``/``time.perf_counter()`` call
+  anywhere in the loop, or a clock read (including ``time.time()``) in the
+  loop *condition* — both shapes bound the loop in wall time;
+- a **backoff**: the slept duration is a variable that the loop body
+  grows multiplicatively (``delay *= 2`` / ``delay = min(delay * 2, cap)``)
+  — geometric growth bounds the *rate*, which is the other accepted
+  contract (and what ``RetryPolicy.delays()`` provides ready-made).
+
+``for``-loop sleeps are out of scope: iteration over a finite sequence
+(e.g. ``RetryPolicy.delays()``) is already bounded.
+
+Exempt (same surface logic as ``bare-print``): the ``scripts/`` and
+``tests/`` trees and test modules — an operator-facing watch script that
+polls forever IS its contract (scripts/tunnel_watch.sh's python siblings).
+"""
+
+import ast
+from typing import Iterator, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.bare_print import _exempt
+
+#: time-module attributes whose call marks a wall-clock budget.
+_CLOCK_FNS = ("monotonic", "perf_counter", "time")
+#: Of those, the ones accepted ANYWHERE in the loop (not just the test):
+#: a monotonic read in the body is almost always a deadline check; a bare
+#: time.time() in the body could be a timestamp, so it only counts when it
+#: appears in the loop condition itself.
+_BODY_CLOCK_FNS = ("monotonic", "perf_counter")
+
+
+def _time_aliases(tree: ast.Module):
+    """(module aliases of ``time``, {fn-name -> set of import aliases})."""
+    mod_aliases, fn_aliases = set(), {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    mod_aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                fn_aliases.setdefault(a.name, set()).add(a.asname or a.name)
+    return mod_aliases, fn_aliases
+
+
+def _is_time_call(node, fn: str, mod_aliases, fn_aliases) -> bool:
+    """Whether ``node`` is a direct call of ``time.<fn>`` (any alias form)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == fn:
+        return isinstance(f.value, ast.Name) and f.value.id in mod_aliases
+    return isinstance(f, ast.Name) and f.id in fn_aliases.get(fn, set())
+
+
+def _multiplied_names(body) -> set:
+    """Names the loop body grows multiplicatively (the backoff shape)."""
+    grown = set()
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Mult):
+                if isinstance(node.target, ast.Name):
+                    grown.add(node.target.id)
+            elif isinstance(node, ast.Assign):
+                has_mult = any(
+                    isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mult)
+                    for n in ast.walk(node.value)
+                )
+                if has_mult:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            grown.add(tgt.id)
+    return grown
+
+
+@register
+class NakedRetryRule(Rule):
+    """Flag deadline-less, backoff-less ``time.sleep`` while-loops."""
+
+    name = "naked-retry"
+    description = (
+        "time.sleep retry/poll loop without a deadline or backoff in "
+        "library code: on this deployment dependencies WEDGE rather than "
+        "error, so an unbounded poll loop becomes a hang; bound it with a "
+        "time.monotonic deadline or route it through resilience/retry.py "
+        "(scripts/tests exempt)"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Flag while-loops sleeping with neither deadline nor backoff."""
+        if _exempt(module):
+            return
+        mod_aliases, fn_aliases = _time_aliases(module.tree)
+        if not (mod_aliases or "sleep" in fn_aliases):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.While):
+                continue
+            sleeps = [
+                n
+                for n in ast.walk(node)
+                if _is_time_call(n, "sleep", mod_aliases, fn_aliases)
+            ]
+            if not sleeps:
+                continue
+            # Escape hatch 1: a wall-time budget.
+            has_deadline = any(
+                _is_time_call(n, fn, mod_aliases, fn_aliases)
+                for n in ast.walk(node)
+                for fn in _BODY_CLOCK_FNS
+            ) or any(
+                _is_time_call(n, fn, mod_aliases, fn_aliases)
+                for n in ast.walk(node.test)
+                for fn in _CLOCK_FNS
+            )
+            if has_deadline:
+                continue
+            # Escape hatch 2: geometric backoff of the slept duration.
+            grown = _multiplied_names(node.body + node.orelse)
+            for sleep_call in sleeps:
+                arg = sleep_call.args[0] if sleep_call.args else None
+                if isinstance(arg, ast.Name) and arg.id in grown:
+                    continue
+                yield "", sleep_call.lineno, (
+                    "time.sleep in a while-loop with no time.monotonic "
+                    "deadline and no backoff: a dependency that wedges "
+                    "(never satisfies the condition) hangs this loop "
+                    "forever; add a monotonic deadline or use "
+                    "resilience.retry.RetryPolicy"
+                )

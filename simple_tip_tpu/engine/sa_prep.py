@@ -43,6 +43,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from simple_tip_tpu import obs
+from simple_tip_tpu.resilience import RetryPolicy, faults
+from simple_tip_tpu.utils.artifacts_io import atomic_write_bytes
 from simple_tip_tpu.ops.surprise import (
     DSA,
     LSA,
@@ -355,12 +357,36 @@ class SAFitCache:
         """Human-readable entry label for cache-hit/miss log lines."""
         return self._path(sa_name)
 
+    @staticmethod
+    def _read(path: str):
+        """One read+unpickle attempt (retried for transient IO only)."""
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
     def load(self, sa_name: str):
-        """The cached fitted scorer, or None on miss/stale/corrupt entries."""
+        """The cached fitted scorer, or None on miss/stale/corrupt entries.
+
+        Transient IO errors (a briefly unavailable shared cache mount —
+        NOT unpickle failures, which retrying cannot fix) are retried
+        under the ``sa_cache`` scope of the unified policy before the
+        entry degrades to a refit. The ``sa_cache.load`` fault seam lets
+        the chaos suite corrupt the on-disk pickle first, driving the
+        REAL corrupt-entry path rather than a mock of it.
+        """
         path = self._path(sa_name)
+        fault = faults.maybe_inject("sa_cache.load", variant=sa_name, path=path)
+        if fault is not None and fault.kind == "corrupt":
+            faults.corrupt_file(path)
         try:
-            with open(path, "rb") as f:
-                entry = pickle.load(f)
+            entry = RetryPolicy.from_env(
+                scope="sa_cache", attempts=2, base_s=0.05, deadline_s=10.0
+            ).call(
+                self._read,
+                path,
+                transient=(OSError,),
+                fatal=(FileNotFoundError,),
+                describe=f"sa-fit cache read ({sa_name})",
+            )
             meta = entry["meta"]
             if (
                 meta["version"] != CACHE_FORMAT_VERSION
@@ -390,9 +416,14 @@ class SAFitCache:
             return None
 
     def store(self, sa_name: str, scorer) -> None:
-        """Persist one fitted scorer (atomic; failures warn, never raise)."""
+        """Persist one fitted scorer (atomic; failures warn, never raise).
+
+        The write rides ``atomic_write_bytes`` (tmp + fsync + rename), so
+        a kill mid-store — the chaos suite injects one at the
+        ``artifact.write`` seam — can never leave a torn entry at the
+        final path: the next reader sees either the old entry or none.
+        """
         path = self._path(sa_name)
-        tmp = f"{path}.{os.getpid()}.tmp"
         try:
             os.makedirs(self.root, exist_ok=True)
             entry = {
@@ -405,14 +436,8 @@ class SAFitCache:
                 },
                 "scorer": scorer,
             }
-            with open(tmp, "wb") as f:
-                pickle.dump(entry, f, protocol=4)
-            os.replace(tmp, path)
+            atomic_write_bytes(path, pickle.dumps(entry, protocol=4))
             logger.info("sa-fit cache stored %s (%s)", sa_name, path)
             obs.counter("sa_fit_cache.store").inc()
         except Exception as e:  # noqa: BLE001 — cache is an optimization only
             logger.warning("sa-fit cache store failed for %s (%r)", sa_name, e)
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass

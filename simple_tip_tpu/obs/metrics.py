@@ -11,7 +11,11 @@ Standing instruments (populated by the instrumented seams):
 
 - ``sa_fit_cache.{hit,miss,stale,corrupt,store}``   engine/sa_prep.py
 - ``scheduler.{requeues,timeouts,worker_deaths}``   parallel/run_scheduler.py
+- ``scheduler.journal_skips`` / ``journal.appends`` resilience/journal.py
 - ``watchdog.{probe_ok,probe_fail,probe_timeout}``  utils/device_watchdog.py
+- ``breaker.{opened,closed,short_circuit,degraded}`` resilience/breaker.py
+- ``retry.{attempts,giveups}``                      resilience/retry.py
+- ``faults.injected[.<site>]``                      resilience/faults.py
 - ``jax.compiles`` / ``jax.compile_seconds``        ``install_jax_hooks``
 - ``device.<id>.peak_bytes_in_use``                 ``record_device_memory``
 

@@ -32,7 +32,10 @@ import json
 import os
 
 #: Counters whose INCREASE between runs is a health regression. Matched as
-#: name prefixes so per-device / per-phase suffixes participate.
+#: name prefixes so per-device / per-phase suffixes participate. The
+#: breaker entries are the anti-BENCH_r05 guarantee: an open circuit (the
+#: loud form of the CPU degradation) fails regress against a healthy
+#: baseline even if the headline value happens to survive.
 HEALTH_COUNTERS = (
     "scheduler.worker_deaths",
     "scheduler.timeouts",
@@ -40,6 +43,10 @@ HEALTH_COUNTERS = (
     "watchdog.probe_fail",
     "watchdog.probe_timeout",
     "sa_fit_cache.corrupt",
+    "breaker.opened",
+    "breaker.short_circuit",
+    "breaker.degraded",
+    "retry.giveups",
 )
 
 #: Default growth threshold (fraction) past which a phase regressed.

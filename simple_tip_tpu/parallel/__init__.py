@@ -14,13 +14,35 @@ parameter ensemble* sharded over a ``jax.sharding.Mesh``:
 On a single chip this still wins big: the case-study models are tiny
 (~100k params), so one chip trains dozens of them at once at high MXU
 utilization instead of 100 sequential fits.
+
+The re-exports are lazy (same pattern as the top-level package): the
+``run_scheduler`` submodule is deliberately jax-free so the spawn workers
+(and the dependency-free CI chaos smoke job) can import it without paying
+— or wedging on — a backend init; an eager ``ensemble`` import here would
+defeat that.
 """
 
-from simple_tip_tpu.parallel.ensemble import (
-    ensemble_mesh,
-    stack_init,
-    train_ensemble,
-    unstack,
-)
+_LAZY_EXPORTS = {
+    "ensemble_mesh": "ensemble",
+    "stack_init": "ensemble",
+    "train_ensemble": "ensemble",
+    "unstack": "ensemble",
+}
 
 __all__ = ["train_ensemble", "stack_init", "unstack", "ensemble_mesh"]
+
+
+def __getattr__(name):
+    """Lazy re-exports of the (jax-heavy) ensemble helpers."""
+    from importlib import import_module
+
+    if name in _LAZY_EXPORTS:
+        return getattr(
+            import_module(f"simple_tip_tpu.parallel.{_LAZY_EXPORTS[name]}"), name
+        )
+    raise AttributeError(f"module 'simple_tip_tpu.parallel' has no attribute {name!r}")
+
+
+def __dir__():
+    """Make the lazy exports visible to dir()/tab-completion."""
+    return sorted(list(globals()) + list(_LAZY_EXPORTS))

@@ -52,6 +52,7 @@ import time
 from typing import Dict, List, Optional
 
 from simple_tip_tpu import obs
+from simple_tip_tpu.resilience import RetryPolicy, faults, journal_from_env
 
 logger = logging.getLogger(__name__)
 
@@ -123,45 +124,77 @@ def _phase_test_sleep(
                 f.write(f"{start} {time.time()} {os.getpid()}")
 
 
-def _phase_test_wedge(cs, ids, marker_dir=None, wedge_ids=(), always_wedge=False, **kw):
-    """Scheduler-test phase emulating a wedged device call: the FIRST attempt
-    at a ``wedge_ids`` id blocks far beyond any test timeout (a tunnel-outage
-    stand-in — the call never returns, it must be terminated); the retry
-    (requeued onto a fresh worker, which sees the attempt marker) completes.
-    With ``always_wedge``, every attempt at a wedge id blocks (the
-    both-attempts-dead path).
+def _phase_test_fault(cs, ids, marker_dir=None, plan=None, seconds=0.0, **kw):
+    """Scheduler-chaos phase: run ids under an INLINE fault plan.
+
+    The generalization the old ``_test_die``/``_test_wedge`` phases grew
+    into (resilience/faults.py): ``plan`` is a fault-plan dict whose
+    ``worker.run`` faults fire per id — ``die`` hard-exits the worker,
+    ``wedge`` blocks until SIGTERM, ``error`` raises — with the
+    cross-process ``times`` ledger (claim markers under ``marker_dir``)
+    replacing the old hand-rolled first-attempt markers. Env-driven plans
+    (``TIP_FAULT_PLAN``) fire at the ``_worker_main`` seam instead and
+    need no special phase at all; this phase exists so tests and the
+    chaos smoke can also write attempt/completion markers.
     """
+    fault_plan = (
+        faults.FaultPlan.from_obj(plan, state_dir=marker_dir) if plan else None
+    )
     for i in ids:
-        attempt_marker = os.path.join(marker_dir, f"attempt_{i}")
-        first_attempt = not os.path.exists(attempt_marker)
-        with open(attempt_marker, "a") as f:
-            f.write(f"{os.getpid()}\n")
-        if i in set(wedge_ids) and (first_attempt or always_wedge):
-            time.sleep(3600)  # "wedged": only SIGTERM ends this attempt
-        with open(os.path.join(marker_dir, f"run_{i}.txt"), "w") as f:
-            f.write(f"{time.time()} {time.time()} {os.getpid()}")
+        if marker_dir:
+            with open(os.path.join(marker_dir, f"attempt_{i}"), "a") as f:
+                f.write(f"{os.getpid()}\n")
+        if fault_plan is not None:
+            fault_plan.fire("worker.run", model_id=i)
+        if seconds:
+            time.sleep(seconds)
+        if marker_dir:
+            with open(os.path.join(marker_dir, f"run_{i}.txt"), "w") as f:
+                f.write(f"{time.time()} {time.time()} {os.getpid()}")
+
+
+def _phase_test_wedge(cs, ids, marker_dir=None, wedge_ids=(), always_wedge=False, **kw):
+    """Compat shim over ``_test_fault``: the FIRST attempt at a
+    ``wedge_ids`` id blocks far beyond any test timeout (a tunnel-outage
+    stand-in — the call never returns, it must be terminated); the retry
+    (requeued onto a fresh worker, which sees the spent fault claim)
+    completes. ``always_wedge`` (``times: 0`` = unlimited) wedges every
+    attempt — the both-attempts-dead path.
+    """
+    plan = {
+        "faults": [
+            {
+                "site": "worker.run",
+                "kind": "wedge",
+                "match": {"model_id": list(wedge_ids)},
+                "times": 0 if always_wedge else 1,
+            }
+        ]
+    }
+    _phase_test_fault(cs, ids, marker_dir=marker_dir, plan=plan, **kw)
 
 
 def _phase_test_die(cs, ids, marker_dir=None, die_ids=(), **kw):
-    """Scheduler-test phase emulating a worker DEATH (segfault/OOM-kill):
-    the first attempt at a ``die_ids`` id hard-exits the process without
-    reporting; the requeued retry (which sees the attempt marker) completes.
+    """Compat shim over ``_test_fault``: the first attempt at a ``die_ids``
+    id hard-exits the worker without reporting (segfault/OOM-kill
+    stand-in); the requeued retry completes. The fault's ``delay_s``
+    (default 0.5) lets the mp.Queue feeder flush the preceding done_q
+    "start" put and RELEASE the shared write-lock semaphore before dying —
+    ``os._exit`` mid-feeder-write would deadlock every sibling process on
+    the orphaned lock (an mp.Queue property, not a scheduler bug).
     """
-    for i in ids:
-        attempt_marker = os.path.join(marker_dir, f"attempt_{i}")
-        first_attempt = not os.path.exists(attempt_marker)
-        with open(attempt_marker, "a") as f:
-            f.write(f"{os.getpid()}\n")
-        if i in set(die_ids) and first_attempt:
-            # Let the queue feeder flush the preceding done_q "start" put
-            # and RELEASE the shared write-lock semaphore before dying:
-            # _exit mid-feeder-write would leave every sibling process
-            # deadlocked on the orphaned lock (an mp.Queue property, not a
-            # scheduler bug — the scheduler only ever reads with timeouts).
-            time.sleep(0.5)
-            os._exit(1)  # no Python teardown: the scheduler sees a dead pid
-        with open(os.path.join(marker_dir, f"run_{i}.txt"), "w") as f:
-            f.write(f"{os.getpid()}")
+    plan = {
+        "faults": [
+            {
+                "site": "worker.run",
+                "kind": "die",
+                "match": {"model_id": list(die_ids)},
+                "times": 1,
+                "delay_s": 0.5,
+            }
+        ]
+    }
+    _phase_test_fault(cs, ids, marker_dir=marker_dir, plan=plan, **kw)
 
 
 PHASES = {
@@ -169,9 +202,15 @@ PHASES = {
     "active_learning": _phase_active_learning,
     "at_collection": _phase_at_collection,
     "_test_sleep": _phase_test_sleep,
+    "_test_fault": _phase_test_fault,
     "_test_wedge": _phase_test_wedge,
     "_test_die": _phase_test_die,
 }
+
+#: Phases that never touch the case study or jax — workers skip the
+#: backend pin, compilation cache and case-study construction for them, so
+#: the scheduler (and the CI chaos smoke job) runs dependency-free.
+_SYNTHETIC_PHASES = frozenset(p for p in PHASES if p.startswith("_test_"))
 
 
 def _worker_main(case_study, phase, work_q, done_q, stop_event, phase_kwargs, env_overrides):
@@ -182,23 +221,29 @@ def _worker_main(case_study, phase, work_q, done_q, stop_event, phase_kwargs, en
     # Routes records to stderr with a [pid/worker-idx] prefix and — when
     # TIP_OBS_DIR is set — into this worker's obs event stream.
     obs.install_worker_logging()
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        # Make the CPU pin binding before any backend init: on deployments
-        # whose sitecustomize pre-registers an accelerator plugin the env
-        # var alone silently loses, and a wedged tunnel then hangs the
-        # worker at its first device op.
-        import jax
+    if phase in _SYNTHETIC_PHASES:
+        # Synthetic/chaos phases never touch the case study or a backend:
+        # skipping the jax imports keeps worker spawn cheap AND lets the
+        # dependency-free CI chaos job exercise the real scheduler.
+        cs = None
+    else:
+        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            # Make the CPU pin binding before any backend init: on
+            # deployments whose sitecustomize pre-registers an accelerator
+            # plugin the env var alone silently loses, and a wedged tunnel
+            # then hangs the worker at its first device op.
+            import jax
 
-        jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_platforms", "cpu")
 
-    from simple_tip_tpu.casestudies.base import get_case_study
-    from simple_tip_tpu.config import enable_compilation_cache
+        from simple_tip_tpu.casestudies.base import get_case_study
+        from simple_tip_tpu.config import enable_compilation_cache
 
-    enable_compilation_cache()
-    # jax is imported (and the backend chosen) by the case-study machinery
-    # above; count this worker's XLA compiles from here on.
-    obs.install_jax_hooks()
-    cs = get_case_study(case_study)
+        enable_compilation_cache()
+        # jax is imported (and the backend chosen) by the case-study
+        # machinery above; count this worker's XLA compiles from here on.
+        obs.install_jax_hooks()
+        cs = get_case_study(case_study)
     fn = PHASES[phase]
     while True:
         try:
@@ -219,6 +264,11 @@ def _worker_main(case_study, phase, work_q, done_q, stop_event, phase_kwargs, en
         # worker holding this id and requeue it.
         done_q.put(("start", model_id, os.getpid()))
         try:
+            # Env-plan chaos seam: a TIP_FAULT_PLAN "worker.run" fault
+            # kills, wedges or errors this attempt AFTER the claim is
+            # announced — the shape of a real mid-run worker loss, for
+            # any phase (error kinds report as per-id failures).
+            faults.maybe_inject("worker.run", phase=phase, model_id=model_id)
             with obs.span(
                 "run", phase=phase, case_study=case_study, model_id=model_id
             ):
@@ -269,12 +319,42 @@ def run_phase_parallel(
     """
     if phase not in PHASES:
         raise ValueError(f"unknown phase {phase!r}; one of {sorted(PHASES)}")
-    num_workers = max(1, min(num_workers, len(model_ids)))
-    if worker_platforms is None:
-        worker_platforms = ["default"] * num_workers
     if run_timeout_s is None:
         run_timeout_s = float(os.environ.get("TIP_RUN_TIMEOUT_S", "3600"))
     phase_kwargs = dict(phase_kwargs or {})
+
+    # Journaled resume (resilience/journal.py): ids already journaled as
+    # completed for this (case study, phase) are skipped outright — a
+    # restarted study pays nothing for finished runs and rides the
+    # restart-safe SAFitCache/artifact bus back to warm state. Off unless
+    # the bus is pinned (TIP_ASSETS) or TIP_JOURNAL names a path.
+    journal = journal_from_env(case_study, phase)
+    already_done = journal.completed() if journal is not None else set()
+    skipped = [m for m in model_ids if m in already_done]
+    pending = [m for m in model_ids if m not in already_done]
+    if skipped:
+        logger.info(
+            "[%s] %s: skipping %d/%d runs already journaled as complete "
+            "(journal: %s; delete it to force re-runs)",
+            case_study, phase, len(skipped), len(model_ids), journal.path,
+        )
+        obs.counter("scheduler.journal_skips").inc(len(skipped))
+        for m in skipped:
+            obs.event("scheduler.skip_journaled", model_id=m, phase=phase)
+
+    num_workers = max(1, min(num_workers, max(1, len(pending))))
+    if worker_platforms is None:
+        worker_platforms = ["default"] * num_workers
+
+    # Requeue budget from the unified retry policy (resilience/retry.py):
+    # attempts=2 keeps the historical contract (one requeue onto a fresh
+    # CPU-pinned worker, then fail). Only the scoped TIP_RETRY_SCHED_*
+    # knobs tune it (inherit=False): requeues cost a whole run_timeout_s
+    # each, so a blanket TIP_RETRY_ATTEMPTS bump for cache/probe IO must
+    # not silently multiply hour-long wedge retries.
+    max_requeues = (
+        RetryPolicy.from_env(scope="sched", inherit=False, attempts=2).attempts - 1
+    )
 
     # Resolve the obs run directory BEFORE any spawn: an ``auto``
     # TIP_OBS_DIR pins itself into os.environ here, so every worker (which
@@ -284,6 +364,7 @@ def run_phase_parallel(
     phase_span = obs.span(
         "scheduler.phase", phase=phase, case_study=case_study,
         runs=len(model_ids), workers=num_workers,
+        journal_skipped=len(skipped),
     )
     phase_span.__enter__()
 
@@ -292,11 +373,11 @@ def run_phase_parallel(
     # Retries ride a SEPARATE queue read only by the CPU-pinned replacement
     # workers: putting a retry back on the shared queue would let an idle
     # default-platform worker — possibly on the same dead tunnel — steal it
-    # and wedge again, burning the id's single retry.
+    # and wedge again, burning the id's retry budget.
     retry_q = ctx.Queue()
     done_q = ctx.Queue()
     stop_event = ctx.Event()
-    for m in model_ids:
+    for m in pending:
         work_q.put(m)
         obs.event("scheduler.announce", model_id=m, phase=phase)
 
@@ -319,21 +400,26 @@ def run_phase_parallel(
         worker_queue[w.pid] = queue
         return w
 
-    for i in range(num_workers):
-        _spawn(worker_platforms[i % len(worker_platforms)])
+    if pending:
+        for i in range(num_workers):
+            _spawn(worker_platforms[i % len(worker_platforms)])
     logger.info(
-        "[%s] %s: %d runs across %d workers (platforms: %s, run timeout %.0fs)",
+        "[%s] %s: %d runs (%d journal-skipped) across %d workers "
+        "(platforms: %s, run timeout %.0fs)",
         case_study,
         phase,
-        len(model_ids),
+        len(pending),
+        len(skipped),
         num_workers,
         worker_platforms[:num_workers],
         run_timeout_s,
     )
 
-    results: Dict[int, Optional[str]] = {}
+    # Journal-skipped ids are pre-resolved successes; everything below
+    # (the progress loop, the final failure report) sees them as done.
+    results: Dict[int, Optional[str]] = {m: None for m in skipped}
     in_flight: Dict[int, Dict] = {}  # id -> {"pid", "deadline"}
-    requeued: set = set()
+    requeues: Dict[int, int] = {}  # id -> requeue count so far
 
     def _handle(msg) -> None:
         kind, model_id, payload = msg
@@ -356,6 +442,8 @@ def run_phase_parallel(
         if payload is None:
             logger.info("[%s] %s: run %d done", case_study, phase, model_id)
             obs.event("scheduler.done", model_id=model_id, phase=phase)
+            if journal is not None:
+                journal.mark_done(model_id)
         else:
             logger.error(
                 "[%s] %s: run %d FAILED: %s", case_study, phase, model_id, payload
@@ -397,16 +485,20 @@ def run_phase_parallel(
                 _spawn("cpu")  # reads work_q
             if model_id in results:
                 continue  # a first attempt already reported; nothing to redo
-            if model_id in requeued:
-                results[model_id] = f"{reason}; already requeued once — giving up"
+            n = requeues.get(model_id, 0)
+            if n >= max_requeues:
+                spent = "once" if n == 1 else f"{n} times"
+                results[model_id] = f"{reason}; already requeued {spent} — giving up"
                 logger.error(
-                    "[%s] %s: run %d failed after requeue", case_study, phase, model_id
+                    "[%s] %s: run %d failed after %d requeue(s)",
+                    case_study, phase, model_id, n,
                 )
             else:
-                requeued.add(model_id)
+                requeues[model_id] = n + 1
                 logger.warning(
-                    "[%s] %s: requeueing run %d onto a fresh CPU-pinned worker (%s)",
-                    case_study, phase, model_id, reason,
+                    "[%s] %s: requeueing run %d onto a fresh CPU-pinned worker "
+                    "(%s; attempt %d/%d)",
+                    case_study, phase, model_id, reason, n + 2, max_requeues + 1,
                 )
                 obs.counter("scheduler.requeues").inc()
                 obs.event(

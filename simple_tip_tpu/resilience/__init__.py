@@ -1,0 +1,60 @@
+"""Fault tolerance for study execution: inject, retry, journal, break.
+
+The 4x100-run study is exactly the workload that dies to partial failures:
+a TPU tunnel flap mid-phase wedges a worker, a kill mid-pickle tears a
+cache entry, a restarted study refits and re-runs everything it had already
+finished, and — worst of all — the BENCH_r01-r05 failure mode, where the
+degraded CPU fallback was *silent* and five rounds of records quietly
+replaced the real chip numbers. Podracer's lesson (PAPERS.md,
+arxiv 2104.06272) is that staying saturated under preemption and worker
+churn is an architecture concern; real TPU fleets run preemptible, so
+failure is the normal path, not the exception.
+
+Four pieces, all stdlib-only (this package is imported by the jax-free
+scheduler workers, the bench parent and the tier-0 chaos smoke job):
+
+- ``faults``   deterministic fault injection at named seams
+  (``TIP_FAULT_PLAN``): worker kill/wedge, backend-probe timeout,
+  SA-cache pickle corruption, artifact torn-writes — the chaos harness
+  the scheduler's old ``_test_die``/``_test_wedge`` phases grew into;
+- ``retry``    one retry policy (exponential backoff + jitter + monotonic
+  deadline + transient/fatal classification, ``TIP_RETRY_*``) replacing
+  the ad-hoc sleep/timeout logic scattered across the watchdog, the
+  scheduler requeue path and the cache/bus readers;
+- ``journal``  a crash-safe append-only journal of completed
+  (case study, phase, run-id) work units under ``$TIP_ASSETS`` —
+  a restarted ``run_phase_parallel`` skips finished runs and rides the
+  already-restart-safe SAFitCache/artifact bus back to warm state;
+- ``breaker``  a closed/open/half-open circuit breaker over the backend
+  probe (``TIP_BREAKER_*``): an open breaker fails fast or *loudly*
+  degrades to CPU, stamping the degradation into bench records and
+  health counters at the source.
+"""
+
+from simple_tip_tpu.resilience.breaker import (
+    BackendUnavailable,
+    CircuitBreaker,
+)
+from simple_tip_tpu.resilience.faults import (
+    FaultPlan,
+    InjectedFault,
+    active_plan,
+    corrupt_file,
+    maybe_inject,
+)
+from simple_tip_tpu.resilience.journal import RunJournal, journal_from_env
+from simple_tip_tpu.resilience.retry import RetryGiveUp, RetryPolicy
+
+__all__ = [
+    "BackendUnavailable",
+    "CircuitBreaker",
+    "FaultPlan",
+    "InjectedFault",
+    "RetryGiveUp",
+    "RetryPolicy",
+    "RunJournal",
+    "active_plan",
+    "corrupt_file",
+    "journal_from_env",
+    "maybe_inject",
+]

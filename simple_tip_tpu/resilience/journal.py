@@ -1,0 +1,130 @@
+"""Crash-safe resume journal of completed (case study, phase, run) units.
+
+The study phases were always *restartable* (file-granular idempotent
+artifacts, the reference's contract) but never *resumable*: a restarted
+``run_phase_parallel`` re-dispatched every id and relied on each phase's
+own artifact checks — which the synthetic/chaos phases don't have, and
+which still re-pays worker spawn + data load + cache probing per finished
+run. The journal closes that gap at the scheduler layer: every run that
+completes successfully appends one JSON line, and a restarted phase skips
+journaled ids outright, riding the already-restart-safe SAFitCache and
+artifact bus back to warm state.
+
+Write discipline (the same crash-safety argument as the obs tracer):
+append-only JSONL, one ``os.write`` per line on an ``O_APPEND`` fd with
+fsync — a mid-append kill leaves at most one torn tail line, which the
+reader skips and counts. No rewrite-in-place ever happens, so no kill can
+eat *previous* completions.
+
+Resolution (``journal_from_env``): ``TIP_JOURNAL`` = ``off``/``0``
+disables; an explicit path is used verbatim; unset/``auto`` journals under
+``$TIP_ASSETS/journal/runs.jsonl`` — but only when ``TIP_ASSETS`` itself
+is pinned, because journaling into an implicit CWD-relative bus would leak
+completion state between unrelated invocations (exactly the kind of
+cross-test contamination the scheduler tests would hit). Semantics: a
+journal entry means "this (case study, phase, id) finished once under this
+bus"; delete the file (or the bus) to force a full re-run.
+
+Stdlib-only; single-writer by construction (only the scheduler parent
+appends; workers report over the done queue).
+"""
+
+import json
+import logging
+import os
+import time
+from typing import Optional, Set
+
+from simple_tip_tpu import obs
+from simple_tip_tpu.resilience import faults
+
+logger = logging.getLogger(__name__)
+
+
+class RunJournal:
+    """Append-only completion ledger for one (case study, phase) pair."""
+
+    def __init__(self, path: str, case_study: str, phase: str):
+        self.path = path
+        self.case_study = case_study
+        self.phase = phase
+
+    def completed(self) -> Set:
+        """Model ids journaled as done for this (case study, phase).
+
+        Torn tail lines (a kill mid-append) and foreign entries are
+        skipped; a missing journal is simply the empty set.
+        """
+        done: Set = set()
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail from a crash mid-append
+                    if (
+                        isinstance(rec, dict)
+                        and rec.get("case_study") == self.case_study
+                        and rec.get("phase") == self.phase
+                        and "model_id" in rec
+                    ):
+                        done.add(rec["model_id"])
+        except OSError:
+            return set()
+        return done
+
+    def mark_done(self, model_id) -> None:
+        """Append one completion line (fsync'd; failures warn, never raise
+        — the journal accelerates restarts, it must not fail the phase)."""
+        rec = {
+            "case_study": self.case_study,
+            "phase": self.phase,
+            "model_id": model_id,
+            "ts": time.time(),
+            "pid": os.getpid(),
+        }
+        line = json.dumps(rec, sort_keys=True) + "\n"
+        data = line.encode("utf-8")
+        fault = faults.maybe_inject(
+            "journal.append", phase=self.phase, model_id=model_id
+        )
+        if fault is not None and fault.kind == "torn":
+            data = data[: max(1, len(data) // 2)]  # simulated mid-append kill
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            fd = os.open(self.path, os.O_APPEND | os.O_CREAT | os.O_RDWR, 0o644)
+            try:
+                # Heal a torn tail left by a previous kill mid-append: a
+                # new line appended straight after half a line would merge
+                # into one unparsable record, losing THIS completion too.
+                if os.lseek(fd, 0, os.SEEK_END) > 0:
+                    os.lseek(fd, -1, os.SEEK_END)
+                    if os.read(fd, 1) != b"\n":
+                        data = b"\n" + data
+                os.write(fd, data)
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            obs.counter("journal.appends").inc()
+        except OSError as e:
+            logger.warning("resume journal append failed (%s): %s", self.path, e)
+
+
+def journal_from_env(case_study: str, phase: str) -> Optional[RunJournal]:
+    """The configured journal, or None when journaling is off (see module
+    docstring for the ``TIP_JOURNAL`` / ``TIP_ASSETS`` resolution)."""
+    raw = os.environ.get("TIP_JOURNAL", "").strip()
+    if raw.lower() in ("off", "0"):
+        return None
+    if raw and raw.lower() not in ("auto", "1", "on"):
+        return RunJournal(raw, case_study, phase)
+    if not os.environ.get("TIP_ASSETS", "").strip():
+        return None  # no pinned bus: journaling would leak across runs
+    from simple_tip_tpu.config import output_folder
+
+    path = os.path.join(output_folder(), "journal", "runs.jsonl")
+    return RunJournal(path, case_study, phase)

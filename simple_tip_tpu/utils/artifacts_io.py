@@ -1,27 +1,97 @@
-"""Atomic JSON persistence for evidence artifacts.
+"""Atomic persistence for evidence artifacts and cache entries.
 
 Every measurement script in this repo follows persist-on-measure (a later
 tunnel outage or kill must never erase evidence that already existed); the
 write itself must therefore be atomic — a reader (the driver, the tunnel
-watcher's gating helper) must never observe a half-written file. One shared
-helper instead of per-script copies of the tmp+rename idiom (round-5
-advisor reuse finding).
+watcher's gating helper, a concurrent scheduler worker sharing the SA fit
+cache) must never observe a half-written file. One shared helper instead
+of per-script copies of the tmp+rename idiom (round-5 advisor reuse
+finding); the SA fit cache and the circuit-breaker state ride the same
+byte-level helper.
+
+Chaos seam: both writers consult the ``artifact.write`` fault site
+(resilience/faults.py). A ``torn`` fault writes half the payload to the
+tmp file and raises before the rename; a ``kill`` fault writes half and
+hard-exits the process — the mid-write kill the atomicity contract exists
+for. Either way the destination path never sees partial bytes, which is
+exactly what the kill-during-store test asserts.
 """
 
 import json
+import logging
 import os
+
+from simple_tip_tpu.resilience import faults
+
+logger = logging.getLogger(__name__)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` via tmp-file + fsync + atomic rename.
+
+    The tmp name is pid-unique so concurrent writers (scheduler workers
+    sharing one cache dir) cannot collide; fsync before the rename because
+    this host loses power/connectivity mid-round often enough that a
+    rename pointing at un-flushed blocks would defeat persist-on-measure.
+    """
+    tmp = f"{path}.{os.getpid()}.tmp"
+    fault = faults.maybe_inject("artifact.write", path=path)
+    torn = fault is not None and fault.kind in ("torn", "kill")
+    try:
+        with open(tmp, "wb") as f:
+            if torn:
+                f.write(data[: max(1, len(data) // 2)])
+                f.flush()
+                os.fsync(f.fileno())
+                if fault.kind == "kill":
+                    os._exit(1)  # simulated power loss mid-write
+                raise faults.InjectedFault(f"torn write injected for {path}")
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # Leave no tmp litter behind a failed write; the destination is
+        # untouched either way (that is the whole point of the rename).
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def atomic_write_json(path: str, obj, indent: int = 1) -> None:
-    """Write ``obj`` as JSON to ``path`` via tmp-file + atomic rename.
+    """Write ``obj`` as JSON to ``path`` atomically (see
+    ``atomic_write_bytes`` for the durability contract)."""
+    atomic_write_bytes(
+        path, json.dumps(obj, indent=indent).encode("utf-8")
+    )
 
-    fsync before the rename: this host loses power/connectivity mid-round
-    often enough that a rename pointing at un-flushed blocks would defeat
-    the persist-on-measure contract.
+
+def load_json(path: str, default=None):
+    """Read a JSON artifact, retrying transient IO; ``default`` on failure.
+
+    The bus side of the unified retry policy (``TIP_RETRY_BUS_*``): a
+    briefly unavailable shared mount must not make a reader conclude an
+    artifact does not exist. A missing file and unparsable content are
+    NOT transient (retrying cannot help) and return ``default``
+    immediately — evidence readers (bench's last-good-TPU record, the
+    measured-baseline proxy) must degrade, never raise.
     """
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(obj, f, indent=indent)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    from simple_tip_tpu.resilience import RetryGiveUp, RetryPolicy
+
+    def _read():
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+
+    try:
+        return RetryPolicy.from_env(
+            scope="bus", attempts=2, base_s=0.05, deadline_s=10.0
+        ).call(
+            _read,
+            transient=(OSError,),
+            fatal=(FileNotFoundError,),
+            describe=f"bus read ({path})",
+        )
+    except (RetryGiveUp, OSError, ValueError):
+        return default

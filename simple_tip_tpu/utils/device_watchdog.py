@@ -1,11 +1,12 @@
-"""Accelerator responsiveness watchdog.
+"""Accelerator responsiveness watchdog, fronted by a circuit breaker.
 
-The TPU in this deployment is reached through a tunnel that can wedge: device
-programs then hang indefinitely rather than erroring (observed: a killed
-client left the device stream stuck; every later jax op blocked forever).
-``ensure_responsive_backend`` probes the default backend and, when the probe
-hangs or fails, switches the process to the CPU backend so benchmarks and
-smoke tests degrade loudly instead of hanging a pipeline forever.
+The TPU in this deployment is reached through a tunnel that can wedge:
+device programs then hang indefinitely rather than erroring (observed: a
+killed client left the device stream stuck; every later jax op blocked
+forever). ``ensure_responsive_backend`` probes the default backend and,
+when the probe hangs or fails, switches the process to the CPU backend so
+benchmarks and smoke tests degrade LOUDLY instead of hanging a pipeline
+forever.
 
 The probe runs in a SUBPROCESS, not a thread: backend initialization inside
 jax is serialized behind a process-wide lock, so an in-process probe that
@@ -13,6 +14,25 @@ wedges during init leaves the lock held and the CPU fallback then blocks on
 the same lock (observed during a live tunnel outage — the previous
 thread-based probe turned the watchdog itself into a hang). A stuck
 subprocess is simply killed.
+
+Resilience integration (this is the promoted form the ROADMAP's
+fleet-scheduler item depends on):
+
+- **circuit breaker** (resilience/breaker.py): consecutive probe failures
+  open a shared breaker; while open, callers skip the ~90 s probe and
+  either fail fast (``TIP_BREAKER_MODE=fail``) or degrade to CPU with the
+  degradation stamped into health counters and ``degradation_reason()`` —
+  which bench.py writes into its record, so ``obs regress`` fails against
+  a healthy baseline instead of silently swallowing a CPU number (the
+  BENCH_r05 failure mode);
+- **unified retry** (resilience/retry.py): a probe that cannot even spawn
+  (transient OSError — fork pressure, a briefly full /tmp) is retried
+  with backoff under the ``watchdog`` scope instead of instantly
+  condemning the backend; a probe that RAN and timed out is evidence,
+  not noise, and is never retried here — that is the breaker's domain;
+- **fault seam** (``watchdog.probe``): a fault plan can force ``timeout``
+  or ``fail`` outcomes without touching a real backend — the tunnel-flap
+  / device-init-failure simulation the chaos suite drives.
 
 Call this BEFORE the first jax device use in the process (bench.py and the
 driver entry do), otherwise the broken backend may already be wedging the
@@ -23,8 +43,16 @@ import logging
 import os
 import subprocess
 import sys
+from typing import Optional, Tuple
 
 from simple_tip_tpu import obs
+from simple_tip_tpu.resilience import (
+    BackendUnavailable,
+    CircuitBreaker,
+    RetryGiveUp,
+    RetryPolicy,
+    faults,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -42,6 +70,65 @@ _CHIP_PROBE = (
 
 _chip_probe_cache: dict = {}
 
+# Why the last ensure_responsive_backend call in this process degraded to
+# CPU (None = it did not): "probe-timeout", "probe-fail", "probe-error",
+# or "breaker-open". bench.py stamps this into its record as
+# ``degraded_reason`` — the degraded-record contract (RUNBOOK §7).
+_last_reason: Optional[str] = None
+
+
+def degradation_reason() -> Optional[str]:
+    """Why this process fell back to CPU, or None if it did not."""
+    return _last_reason
+
+
+def _spawn_probe(code: str) -> subprocess.Popen:
+    """Launch one probe subprocess (retried for transient spawn errors)."""
+    return subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=os.environ.copy(),
+    )
+
+
+def _run_probe(code: str, timeout_s: float) -> Tuple[str, str]:
+    """One probe round: ('ok', stdout) | ('fail', detail) | ('timeout', '').
+
+    The ``watchdog.probe`` fault seam can dictate the outcome without
+    spawning anything (the chaos suite's tunnel-flap stand-in). Spawn
+    failures are retried with backoff (``TIP_RETRY_WATCHDOG_*``); a probe
+    that actually timed out is killed (bounded wait — a child wedged in an
+    uninterruptible device ioctl can survive SIGKILL; abandon it rather
+    than hang ourselves) and never retried here.
+    """
+    fault = faults.maybe_inject("watchdog.probe", timeout_s=timeout_s)
+    if fault is not None and fault.kind == "timeout":
+        return "timeout", ""
+    if fault is not None and fault.kind == "fail":
+        return "fail", "injected probe failure"
+    try:
+        proc = RetryPolicy.from_env(
+            scope="watchdog", attempts=2, base_s=0.5, deadline_s=30.0
+        ).call(_spawn_probe, code, describe="device probe spawn")
+    except (RetryGiveUp, ValueError) as e:
+        return "fail", f"probe could not run ({e})"
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            logger.error("probe child survived SIGKILL; abandoning it")
+        return "timeout", ""
+    if proc.returncode == 0 and out.strip():
+        return "ok", out
+    return "fail", (
+        f"probe exited {proc.returncode} (stderr tail: {(err or '').strip()[-300:]})"
+    )
+
 
 def probe_local_chips(timeout_s: float = 90.0) -> int:
     """Number of responsive local accelerator chips, WITHOUT initializing any
@@ -52,112 +139,51 @@ def probe_local_chips(timeout_s: float = 90.0) -> int:
     runtimes with exclusive per-process device access a parent-side init
     would wedge or fail the worker, and during a tunnel outage the parent
     init itself would hang (round-2 advisor, medium). Returns 0 when CPU is
-    forced via ``JAX_PLATFORMS``, when the default platform is cpu, or when
-    the probe fails or times out. The (timeout-keyed) result is cached: the
+    forced via ``JAX_PLATFORMS``, when the default platform is cpu, when
+    the probe fails or times out — or, immediately, when the backend
+    circuit breaker is open (no point burning a 90 s probe per dispatch
+    during a known outage). The (timeout-keyed) result is cached: the
     probe costs a jax import + device init per call.
     """
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         return 0
     if timeout_s in _chip_probe_cache:
         return _chip_probe_cache[timeout_s]
+    breaker = CircuitBreaker.from_env()
+    if breaker is not None and not breaker.allow():
+        return 0  # NOT cached: the breaker may close before the next call
+    outcome, out = _run_probe(_CHIP_PROBE, timeout_s)
     chips = 0
-    try:
-        proc = subprocess.Popen(
-            [sys.executable, "-c", _CHIP_PROBE],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-            env=os.environ.copy(),
-        )
+    if outcome == "ok":
         try:
-            out, err = proc.communicate(timeout=timeout_s)
-            if proc.returncode == 0 and out.strip():
-                platform, n = out.strip().splitlines()[-1].split()
-                chips = 0 if platform == "cpu" else int(n)
-                obs.counter("watchdog.probe_ok").inc()
-            else:
-                logger.error(
-                    "chip-count probe exited %s (stderr tail: %s) — assuming 0",
-                    proc.returncode,
-                    (err or "").strip()[-300:],
-                )
-                obs.counter("watchdog.probe_fail").inc()
-        except subprocess.TimeoutExpired:
-            logger.error(
-                "chip-count probe unresponsive after %.0fs — assuming 0 chips",
-                timeout_s,
-            )
-            obs.counter("watchdog.probe_timeout").inc()
-            proc.kill()
-            try:
-                proc.wait(timeout=5)
-            except subprocess.TimeoutExpired:  # pragma: no cover
-                logger.error("probe child survived SIGKILL; abandoning it")
-    except (OSError, subprocess.SubprocessError, ValueError) as e:
-        logger.error("chip-count probe could not run (%s) — assuming 0", e)
+            platform, n = out.strip().splitlines()[-1].split()
+            chips = 0 if platform == "cpu" else int(n)
+            obs.counter("watchdog.probe_ok").inc()
+            if breaker is not None:
+                breaker.record_success()
+        except ValueError:
+            logger.error("chip-count probe output unparsable: %r", out[-200:])
+            obs.counter("watchdog.probe_fail").inc()
+    elif outcome == "timeout":
+        logger.error(
+            "chip-count probe unresponsive after %.0fs — assuming 0 chips",
+            timeout_s,
+        )
+        obs.counter("watchdog.probe_timeout").inc()
+        if breaker is not None:
+            breaker.record_failure()
+    else:
+        logger.error("chip-count probe failed (%s) — assuming 0", out)
+        obs.counter("watchdog.probe_fail").inc()
+        if breaker is not None:
+            breaker.record_failure()
     _chip_probe_cache[timeout_s] = chips
     return chips
 
 
-def ensure_responsive_backend(timeout_s: float = 90.0) -> str:
-    """Return the platform that will be used ('tpu', 'cpu', ...).
-
-    Probes the default jax backend with a tiny jitted op in a subprocess;
-    if that does not complete within ``timeout_s``, reconfigures this
-    process for the CPU backend. Every failure mode of the probe itself
-    (spawn failure, crash, hang, kill-resistant D-state child) degrades to
-    the CPU fallback — this function must never hang or raise.
-    """
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        # CPU is already forced (tests, explicit fallback): nothing to probe,
-        # and skipping avoids paying a jax import in a discarded subprocess.
-        # The env var alone is NOT enough on deployments whose sitecustomize
-        # pre-registers an accelerator plugin (it silently wins over the env);
-        # setting jax.config makes the CPU choice binding.
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-        return "cpu"
-    try:
-        proc = subprocess.Popen(
-            [sys.executable, "-c", _PROBE],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-            env=os.environ.copy(),
-        )
-        try:
-            out, err = proc.communicate(timeout=timeout_s)
-            if proc.returncode == 0 and out.strip():
-                platform = out.strip().splitlines()[-1]
-                obs.counter("watchdog.probe_ok").inc()
-                obs.event("watchdog.probe", outcome="ok", platform=platform)
-                return platform
-            logger.error(
-                "device probe exited %s (stderr tail: %s) — falling back to CPU",
-                proc.returncode,
-                err.strip()[-300:],
-            )
-            obs.counter("watchdog.probe_fail").inc()
-            obs.event("watchdog.probe", outcome="fail", rc=proc.returncode)
-        except subprocess.TimeoutExpired:
-            logger.error(
-                "default accelerator unresponsive after %.0fs — falling back "
-                "to CPU",
-                timeout_s,
-            )
-            obs.counter("watchdog.probe_timeout").inc()
-            obs.event("watchdog.probe", outcome="timeout", timeout_s=timeout_s)
-            proc.kill()
-            try:
-                # bounded: a child wedged in an uninterruptible device ioctl
-                # can survive SIGKILL; abandon it rather than hang ourselves
-                proc.wait(timeout=5)
-            except subprocess.TimeoutExpired:  # pragma: no cover
-                logger.error("probe child survived SIGKILL; abandoning it")
-    except (OSError, subprocess.SubprocessError) as e:
-        logger.error("device probe could not run (%s) — falling back to CPU", e)
-
+def _force_cpu() -> None:
+    """Bind this process to the CPU backend (env var + jax.config: the env
+    alone silently loses to sitecustomize plugin pre-registration)."""
     import jax
 
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -168,4 +194,71 @@ def ensure_responsive_backend(timeout_s: float = 90.0) -> str:
         jax.extend.backend.clear_backends()
     except Exception:  # pragma: no cover
         pass
+
+
+def ensure_responsive_backend(timeout_s: float = 90.0) -> str:
+    """Return the platform that will be used ('tpu', 'cpu', ...).
+
+    Probes the default jax backend with a tiny jitted op in a subprocess;
+    if that does not complete within ``timeout_s``, reconfigures this
+    process for the CPU backend. Every failure mode of the probe itself
+    (spawn failure, crash, hang, kill-resistant D-state child) degrades to
+    the CPU fallback — this function must never hang and raises ONLY when
+    the circuit breaker is open with ``TIP_BREAKER_MODE=fail`` (the
+    fail-fast contract callers opted into). Degradations are loud:
+    ``degradation_reason()`` reports why, and the breaker counts every
+    short-circuit into the health counters ``obs regress`` gates on.
+    """
+    global _last_reason
+    _last_reason = None
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # CPU is already forced (tests, explicit fallback): nothing to probe,
+        # and skipping avoids paying a jax import in a discarded subprocess.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu"
+
+    breaker = CircuitBreaker.from_env()
+    if breaker is not None and not breaker.allow():
+        if breaker.mode == "fail":
+            raise BackendUnavailable(
+                "backend circuit breaker is open (recent probe failures) and "
+                "TIP_BREAKER_MODE=fail: refusing to degrade to CPU; wait out "
+                "the cooldown, fix the tunnel, or delete the breaker state "
+                "file to force a probe"
+            )
+        logger.error(
+            "backend circuit breaker OPEN — degrading to CPU WITHOUT a probe; "
+            "this run's records will be stamped degraded (reason: breaker-open)"
+        )
+        obs.counter("breaker.degraded").inc()
+        _last_reason = "breaker-open"
+        _force_cpu()
+        return "cpu"
+
+    outcome, detail = _run_probe(_PROBE, timeout_s)
+    if outcome == "ok":
+        platform = detail.strip().splitlines()[-1]
+        obs.counter("watchdog.probe_ok").inc()
+        obs.event("watchdog.probe", outcome="ok", platform=platform)
+        if breaker is not None:
+            breaker.record_success()
+        return platform
+    if outcome == "timeout":
+        logger.error(
+            "default accelerator unresponsive after %.0fs — falling back to CPU",
+            timeout_s,
+        )
+        obs.counter("watchdog.probe_timeout").inc()
+        obs.event("watchdog.probe", outcome="timeout", timeout_s=timeout_s)
+        _last_reason = "probe-timeout"
+    else:
+        logger.error("device probe failed (%s) — falling back to CPU", detail)
+        obs.counter("watchdog.probe_fail").inc()
+        obs.event("watchdog.probe", outcome="fail", detail=str(detail)[:200])
+        _last_reason = "probe-fail"
+    if breaker is not None:
+        breaker.record_failure()
+    _force_cpu()
     return "cpu"

@@ -593,8 +593,89 @@ print(time.time() - t0)
 ''',
 }
 
+BAD_NAKED_RETRY = {
+    "engine/poller.py": '''"""m."""
+import time
+
+
+def wait_ready(check):
+    """Unbounded poll: wedged dependency = infinite hang."""
+    while not check():
+        time.sleep(1.0)
+
+
+def wait_flag(path):
+    """Constant re-sleep, no budget."""
+    import os
+    while True:
+        if os.path.exists(path):
+            return
+        time.sleep(0.5)
+''',
+    "engine/poller_from_import.py": '''"""m."""
+from time import sleep
+
+
+def wait_ready(check):
+    """from-import form is the same hang."""
+    while not check():
+        sleep(2)
+''',
+}
+
+GOOD_NAKED_RETRY = {
+    "engine/poller.py": '''"""m."""
+import time
+
+
+def wait_with_deadline(check, budget_s):
+    """A monotonic deadline bounds the loop in wall time."""
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        if check():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def wait_with_backoff(check):
+    """Geometric backoff bounds the poll rate (RetryPolicy's shape)."""
+    delay = 0.1
+    while not check():
+        time.sleep(delay)
+        delay = min(delay * 2, 5.0)
+
+
+def wait_over_policy_delays(check, delays):
+    """for-loop over a finite delay sequence is already bounded."""
+    for delay in delays:
+        if check():
+            return True
+        time.sleep(delay)
+    return False
+
+
+def barrier(arrived, n, timeout):
+    """Wall-clock deadline in the loop CONDITION also counts."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if arrived() >= n:
+            break
+        time.sleep(0.05)
+''',
+    # The scripts/ tree is exempt: an operator watch loop that polls
+    # forever is its documented contract.
+    "scripts/watch.py": '''"""m."""
+import time
+
+while True:
+    time.sleep(900)
+''',
+}
+
 FIXTURES = {
     "jit-purity": (BAD_JIT_PURITY, GOOD_JIT_PURITY),
+    "naked-retry": (BAD_NAKED_RETRY, GOOD_NAKED_RETRY),
     "bare-print": (BAD_BARE_PRINT, GOOD_BARE_PRINT),
     "wallclock-duration": (BAD_WALLCLOCK, GOOD_WALLCLOCK),
     "prng-hygiene": (BAD_PRNG, GOOD_PRNG),
