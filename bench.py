@@ -457,23 +457,47 @@ def main():
             "mfu": 0.0,
             "error": "all measurement attempts failed or timed out",
         }
-    # Delta vs the previous round's committed bench record (obs v2): the
+    # Delta vs the previous round's committed bench record (obs v2/v3): the
     # regress comparator flags a degraded flip / value drop / health-counter
-    # growth right in the record. Companion data — never fatal, and the
-    # import is stdlib-only (simple_tip_tpu.obs.regress touches no jax).
+    # growth right in the record. The baseline is the newest COMPARABLE
+    # round — never a degraded one (r02–r05 are all CPU fallbacks; diffing
+    # against them normalized the outage), falling back to the newest
+    # embedded last_good_tpu record, else an explicit skip marker.
+    # Companion data — never fatal, and the import is stdlib-only
+    # (simple_tip_tpu.obs.regress touches no jax).
     try:
         from simple_tip_tpu.obs import regress as obs_regress
 
         here = os.path.dirname(os.path.abspath(__file__))
-        rounds = sorted(
+        baseline, note = obs_regress.select_bench_baseline(here)
+        if baseline is not None:
+            rec["vs_previous"] = obs_regress.bench_delta(
+                rec, baseline["source"], baseline_snapshot=baseline
+            )
+            rec["vs_previous"]["baseline_note"] = note
+        else:
+            rec["vs_previous"] = {"skipped": "no_comparable_baseline"}
+        # N-run trend gate over the whole committed BENCH history: the
+        # current record against median/MAD bands of its non-degraded
+        # predecessors (verdict no_comparable_baseline while the history
+        # is all-degraded — honest, not green).
+        snaps = []
+        for name in sorted(
             n
             for n in os.listdir(here)
             if n.startswith("BENCH_r") and n.endswith(".json")
-        )
-        if rounds:
-            rec["vs_previous"] = obs_regress.bench_delta(
-                rec, os.path.join(here, rounds[-1])
-            )
+        ):
+            try:
+                snaps.append(obs_regress.load_snapshot(os.path.join(here, name)))
+            except ValueError:
+                continue  # r01-style wrapper with parsed: null
+        snaps.append(obs_regress._normalize_bench(rec, "<current run>"))
+        tr = obs_regress.trend(snaps)
+        rec["vs_trend"] = {
+            "verdict": tr["verdict"],
+            "n_baseline": tr["n_baseline"],
+            "regressions": sorted({r["name"] for r in tr["regressions"]}),
+        }
     except Exception:  # noqa: BLE001 — the one-JSON-line contract wins
         pass
     if rec.get("degraded", True):

@@ -151,6 +151,46 @@ def main() -> int:
     from simple_tip_tpu import obs
 
     obs.install_jax_hooks()
+    # Admission control (obs v3): quote the cost model's estimate for the
+    # whole study before launching anything, and stamp predicted_s next to
+    # the root span's eventual actual_s so every completed study grades the
+    # model. Advisory: an empty index prints the insufficient-corpus note
+    # and changes nothing.
+    predicted_study_s = None
+    try:
+        from simple_tip_tpu.obs import costmodel, store
+
+        corpus = store.load_rows()
+        if corpus:
+            prediction = costmodel.predict_study(
+                costmodel.fit(corpus),
+                [p for p in phases if p != "evaluation"],
+                runs=len(my_runs),
+                case_studies=len(case_studies),
+                platform=platform,
+                workers=max(1, args.workers),
+            )
+            if prediction["ok"]:
+                predicted_study_s = prediction["total_s"]
+                print(
+                    f"cost model: predicted wall-clock "
+                    f"{prediction['total_s']:.0f}s "
+                    f"(+/- {prediction['error_s']:.0f}s)"
+                    + (
+                        f"; insufficient corpus for "
+                        f"{','.join(prediction['insufficient'])}"
+                        if prediction["insufficient"]
+                        else ""
+                    )
+                )
+            else:
+                print(
+                    "cost model: insufficient corpus for every phase — "
+                    "no wall-clock prediction (grow the index with "
+                    "`python -m simple_tip_tpu.obs runs`)"
+                )
+    except Exception:  # noqa: BLE001 — advisory, never blocks a launch
+        pass
     # Study root span (per host): every phase span and scheduler worker
     # below nests under it, so the whole study exports as one flame-chart
     # tree (`python -m simple_tip_tpu.obs export $TIP_ASSETS/obs/<run>`).
@@ -160,8 +200,10 @@ def main() -> int:
         phases=",".join(phases),
         runs=len(my_runs),
         host=jax.process_index(),
+        **({"predicted_s": predicted_study_s} if predicted_study_s else {}),
     )
     study_span.__enter__()
+    study_started = time.perf_counter()
 
     for phase in phases:
         if phase == "evaluation":
@@ -203,8 +245,24 @@ def main() -> int:
                 f"[{phase}:{cs_name}] runs {my_runs[0]}..{my_runs[-1]} "
                 f"in {time.perf_counter() - t0:.0f}s"
             )
-    study_span.__exit__(None, None, None)
+    study_span.set(
+        actual_s=round(time.perf_counter() - study_started, 3)
+    ).__exit__(None, None, None)
     obs.flush_metrics()
+    # Feed the corpus: fold this study's fresh trace (plus any bench/host
+    # records beside the assets bus) into the feature-store index so the
+    # NEXT launch predicts from it. Companion work — never fatal.
+    try:
+        from simple_tip_tpu.obs import store
+
+        if obs.enabled() and obs.obs_dir():
+            report = store.refresh([obs.obs_dir()])
+            print(
+                f"obs index: +{report['rows_appended']} rows -> "
+                f"{report['rows_total']} ({report['index']})"
+            )
+    except Exception:  # noqa: BLE001 — advisory, never blocks the exit
+        pass
     if obs.enabled():
         print(
             f"obs events in {obs.obs_dir()} — inspect with "

@@ -18,5 +18,6 @@ from simple_tip_tpu.analysis.rules import (  # noqa: F401
     shape_poly,
     sharding_spec,
     transitive_purity,
+    unversioned_schema,
     wallclock_duration,
 )

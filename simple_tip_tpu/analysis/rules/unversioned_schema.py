@@ -1,0 +1,164 @@
+"""Rule ``unversioned-schema``: obs JSONL writers must stamp a ``schema``.
+
+The obs subsystem persists append-only JSONL that OUTLIVES the code that
+wrote it: event streams are committed as test fixtures, the feature-store
+index accumulates across releases, and the trend gate reads months-old
+rows. A writer that emits rows without a ``schema`` version field makes
+every future format change either silently misread old rows or force a
+wipe of the corpus the cost model learns from. The contract (README
+"Observability", ``obs/store.py``): any module under ``obs/`` that writes
+JSONL lines must stamp a ``schema`` field into what it writes — a module
+top-level ``SCHEMA`` constant that appears as a ``"schema"`` key in some
+dict literal (or ``rec["schema"] = ...`` store) satisfies it.
+
+Detection is intentionally coarse but low-noise: a "JSONL write site" is a
+``json.dumps(...)`` call (alias-aware) that is concatenated with a string
+containing a newline, passed directly to a ``.write(...)`` /
+``.writelines(...)`` sink, or joined line-wise — the repo's universal
+``fh.write(json.dumps(rec) + "\\n")`` idiom. ``json.dump(doc, fh)``
+(whole-document JSON) and ``print(json.dumps(doc))`` (CLI output, not a
+persistent stream) are out of scope: single documents are replaced
+atomically, not appended to forever.
+"""
+
+import ast
+from typing import Iterator, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+
+
+def _scoped(module: ModuleInfo) -> bool:
+    """Whether ``module`` lives in an ``obs`` package (any path segment)."""
+    parts = module.relpath.split("/")
+    return "obs" in parts[:-1]
+
+
+def _dumps_aliases(tree):
+    """``(module_aliases, func_aliases)`` resolving to ``json.dumps`` here.
+
+    Covers ``import json`` (-> ``json.dumps`` attribute calls, recorded as
+    ``"json"``), ``import json as j`` and ``from json import dumps [as d]``.
+    """
+    module_aliases, func_aliases = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "json":
+                    module_aliases.add(alias.asname or "json")
+        elif isinstance(node, ast.ImportFrom) and node.module == "json":
+            for alias in node.names:
+                if alias.name == "dumps":
+                    func_aliases.add(alias.asname or "dumps")
+    return module_aliases, func_aliases
+
+
+def _is_dumps_call(node, module_aliases, func_aliases) -> bool:
+    """Whether ``node`` is a ``json.dumps(...)`` call under any alias."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "dumps":
+        return isinstance(f.value, ast.Name) and f.value.id in module_aliases
+    return isinstance(f, ast.Name) and f.id in func_aliases
+
+
+def _newline_str(node) -> bool:
+    """Whether ``node`` is a string constant containing a newline."""
+    return (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and "\n" in node.value
+    )
+
+
+def _jsonl_write_sites(tree, module_aliases, func_aliases):
+    """Line numbers where a ``json.dumps`` result becomes a JSONL line.
+
+    Sites: ``dumps(...) + "...\\n"`` (either operand order), ``dumps(...)``
+    as a direct argument of a ``.write(...)``/``.writelines(...)`` sink,
+    and ``"\\n".join(... dumps ...)``.
+    """
+    sites = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            pairs = ((node.left, node.right), (node.right, node.left))
+            for dumps_side, str_side in pairs:
+                if _is_dumps_call(
+                    dumps_side, module_aliases, func_aliases
+                ) and _newline_str(str_side):
+                    sites.append(node.lineno)
+                    break
+        elif isinstance(node, ast.Call):
+            is_write_sink = (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("write", "writelines")
+            )
+            if is_write_sink:
+                for arg in node.args:
+                    if _is_dumps_call(arg, module_aliases, func_aliases):
+                        sites.append(node.lineno)
+                        break
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+                and _newline_str(node.func.value)
+            ):
+                for sub in ast.walk(node):
+                    if _is_dumps_call(sub, module_aliases, func_aliases):
+                        sites.append(node.lineno)
+                        break
+    return sites
+
+
+def _stamps_schema(tree) -> bool:
+    """Whether the module ever writes a ``"schema"`` key into a dict.
+
+    Accepts a ``"schema"`` key in any dict literal, a ``x["schema"] = ...``
+    subscript store, or ``dict(schema=...)`` / any call with a ``schema``
+    keyword — the stamp idioms tracer.py and store.py use.
+    """
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            for key in node.keys:
+                if isinstance(key, ast.Constant) and key.value == "schema":
+                    return True
+        elif isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, ast.Store
+        ):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and sl.value == "schema":
+                return True
+        elif isinstance(node, ast.Call):
+            if any(kw.arg == "schema" for kw in node.keywords):
+                return True
+    return False
+
+
+@register
+class UnversionedSchemaRule(Rule):
+    """Flag obs modules that write JSONL rows without a ``schema`` stamp."""
+
+    name = "unversioned-schema"
+    description = (
+        "a module under obs/ writes JSONL rows but never stamps a "
+        "'schema' version field; appended rows outlive the writer, so "
+        "unversioned rows make every format change corrupt the corpus"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Flag JSONL write sites in obs modules lacking a schema stamp."""
+        if not _scoped(module):
+            return
+        module_aliases, func_aliases = _dumps_aliases(module.tree)
+        if not module_aliases and not func_aliases:
+            return
+        sites = _jsonl_write_sites(module.tree, module_aliases, func_aliases)
+        if not sites or _stamps_schema(module.tree):
+            return
+        for lineno in sites:
+            yield "", lineno, (
+                "JSONL row written without a 'schema' version stamp: rows "
+                "in an append-only obs stream/index outlive this writer — "
+                "add a module SCHEMA constant and stamp '\"schema\": "
+                "SCHEMA' into every row (see obs/store.py)"
+            )

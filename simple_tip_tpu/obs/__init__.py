@@ -13,12 +13,23 @@ per-phase summary table or one Perfetto/Chrome flame chart:
 - ``obs.event("scheduler.requeue", model_id=3)``          lifecycle events
 - ``obs.counter("sa_fit_cache.hit").inc()``               metrics registry
 - ``python -m simple_tip_tpu.obs summary|export|check|regress``  inspection
+- ``python -m simple_tip_tpu.obs runs|predict|trend``     feature store,
+  cost model, N-run trend gate (obs v3)
 
 obs v2 adds the trace lifecycle (``TIP_OBS_MAX_BYTES`` rotating size cap
 with oldest-segment eviction, ``TIP_OBS_SAMPLE`` keep-1-in-N span
 sampling, the ``study_root`` span every process's top spans nest under),
 ``export --splice-xla`` (device timelines merged into the host flame
 chart) and ``regress`` (cross-run per-phase/metric regression gating).
+
+obs v3 closes the loop from telemetry to scheduling: ``store`` normalizes
+every run's trace/bench/host record into schema-versioned (run, phase)
+feature rows in an append-only index (``TIP_OBS_INDEX``, default
+``$TIP_ASSETS/obs/index``); ``costmodel`` fits a stdlib least-squares
+per-phase cost model over it and predicts study wall-clock pre-launch
+(run_scheduler and scripts/full_study.py stamp ``predicted_s`` vs
+``actual_s`` into their spans); ``regress.trend`` replaces 2-run diffs
+with robust median/MAD bands over the last K non-degraded runs.
 
 Zero third-party dependencies (stdlib json), crash-safe (append-only JSONL;
 partial files still parse line-wise), and no-op when ``TIP_OBS_DIR`` is

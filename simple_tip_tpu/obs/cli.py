@@ -21,6 +21,24 @@
   counter growth). ``--against`` names the baseline explicitly; with no
   current operand the newest ``BENCH_r*.json`` in the working directory
   is compared.
+- ``runs``     build/refresh the telemetry feature store (``obs/store.py``):
+  normalize obs run dirs + ``BENCH_r*.json`` + ``HOST_PHASE.json`` +
+  ``MULTICHIP_r*.json`` under the given roots into schema-versioned
+  (run, phase) feature rows in the append-only index at ``TIP_OBS_INDEX``
+  (default ``$TIP_ASSETS/obs/index``), then print the queryable table.
+- ``predict``  fit the per-phase cost model (``obs/costmodel.py``) over the
+  index and estimate wall-clock for a proposed study config (case studies
+  x runs x phases x backend x workers), with a stated error and a loud
+  insufficient-corpus fallback.
+- ``trend``    gate the LAST of N chronological snapshots against robust
+  median/MAD trend bands over its non-degraded predecessors
+  (``obs/regress.py``'s N-run upgrade of the 2-run diff).
+
+Exit codes (``regress`` and ``trend``, so CI can tell skip from failure):
+**0** inside the band / no regression, **1** regression detected,
+**2** bad input (unreadable/unrecognizable snapshot), **3** no comparable
+baseline (empty corpus, all-degraded history — a skip, not a failure).
+``predict`` reuses 3 for "insufficient corpus for every requested phase".
 
 ``export --splice-xla`` additionally reads each span's ``xla_trace_dir``
 attribute (written by ``utils/profiling.maybe_trace`` when
@@ -422,10 +440,11 @@ def _regress(args) -> int:
         ):
             print(
                 "obs regress: no CURRENT operand and no newer BENCH_r*.json "
-                "in the working directory",
+                "in the working directory (exit 3: nothing comparable, "
+                "not a regression)",
                 file=sys.stderr,
             )
-            return 2
+            return 3
     if targets:
         print(f"obs regress: unexpected extra operands {targets}", file=sys.stderr)
         return 2
@@ -454,6 +473,94 @@ def _regress(args) -> int:
         )
     else:
         print(regress_mod.render(result, baseline, current))
+    return 0 if result["ok"] else 1
+
+
+def _runs(args) -> int:
+    """``obs runs`` entry: refresh the feature-store index, print it."""
+    from simple_tip_tpu.obs import store
+
+    index_dir = args.index or store.default_index_dir()
+    if not args.no_refresh:
+        report = store.refresh(args.roots or [os.getcwd()], index_dir)
+        print(
+            f"index: {report['index']}  sources: {report['sources']} "
+            f"({len(report['indexed'])} indexed, {report['skipped']} "
+            f"unchanged)  rows: +{report['rows_appended']} -> "
+            f"{report['rows_total']}",
+            file=sys.stderr,
+        )
+    rows = store.load_rows(index_dir)
+    if args.json:
+        print(json.dumps(rows, indent=2, sort_keys=True))
+    else:
+        print(store.render_rows(rows, limit=args.limit))
+    return 0
+
+
+def _predict(args) -> int:
+    """``obs predict`` entry: fit over the index, estimate study wall-clock."""
+    from simple_tip_tpu.obs import costmodel, store
+
+    rows = store.load_rows(args.index or store.default_index_dir())
+    if not rows:
+        print(
+            "obs predict: the feature-store index is empty — run "
+            "`obs runs <roots>` first (exit 3: insufficient corpus)",
+            file=sys.stderr,
+        )
+        return 3
+    phases = [p.strip() for p in args.phases.split(",") if p.strip()]
+    if not phases:
+        print("obs predict: --phases must name at least one phase", file=sys.stderr)
+        return 2
+    model = costmodel.fit(rows)
+    result = costmodel.predict_study(
+        model,
+        phases,
+        runs=args.runs,
+        case_studies=args.case_studies,
+        platform=args.platform,
+        workers=args.workers,
+        batch=args.batch,
+    )
+    if args.json:
+        print(json.dumps(result, indent=2, sort_keys=True))
+    else:
+        print(costmodel.render_prediction(result))
+    if not result["ok"]:
+        print(
+            "obs predict: INSUFFICIENT CORPUS — no requested phase has any "
+            "estimate (exit 3)",
+            file=sys.stderr,
+        )
+        return 3
+    return 0
+
+
+def _trend(args) -> int:
+    """``obs trend`` entry: N-run trend gate; exit 0/1/2/3."""
+    from simple_tip_tpu.obs import regress as regress_mod
+
+    try:
+        snapshots = [regress_mod.load_snapshot(t) for t in args.targets]
+    except ValueError as e:
+        print(f"obs trend: {e}", file=sys.stderr)
+        return 2
+    kwargs = {}
+    if args.window is not None:
+        kwargs["window"] = args.window
+    if args.band is not None:
+        kwargs["band"] = args.band
+    if args.min_baseline is not None:
+        kwargs["min_baseline"] = args.min_baseline
+    result = regress_mod.trend(snapshots, **kwargs)
+    if args.json:
+        print(json.dumps(result, indent=2, sort_keys=True))
+    else:
+        print(regress_mod.render_trend(result))
+    if result["verdict"] == "no_comparable_baseline":
+        return 3
     return 0 if result["ok"] else 1
 
 
@@ -515,10 +622,108 @@ def main(argv=None) -> int:
         "fraction (default 0.25)",
     )
     rp.add_argument("--json", action="store_true", help="machine-readable output")
+
+    runp = sub.add_parser(
+        "runs",
+        help="build/refresh the feature-store index and print the row table",
+    )
+    runp.add_argument(
+        "roots",
+        nargs="*",
+        help="directories/files to index (obs run dirs, BENCH_r*.json, "
+        "HOST_PHASE.json, MULTICHIP_r*.json); default: the working dir",
+    )
+    runp.add_argument(
+        "--index",
+        default=None,
+        metavar="DIR",
+        help="index directory (default: $TIP_OBS_INDEX or $TIP_ASSETS/obs/index)",
+    )
+    runp.add_argument(
+        "--no-refresh",
+        action="store_true",
+        help="query the existing index without re-walking the sources",
+    )
+    runp.add_argument(
+        "--limit",
+        type=int,
+        default=None,
+        metavar="N",
+        help="only print the newest N rows",
+    )
+    runp.add_argument("--json", action="store_true", help="machine-readable output")
+
+    pp = sub.add_parser(
+        "predict",
+        help="estimate study wall-clock from the cost model over the index",
+    )
+    pp.add_argument(
+        "--phases",
+        required=True,
+        metavar="A,B,...",
+        help="comma-separated phase names the study will run",
+    )
+    pp.add_argument(
+        "--runs", type=int, default=100, metavar="N",
+        help="runs per case study (default 100, the paper's study size)",
+    )
+    pp.add_argument(
+        "--case-studies", type=int, default=1, metavar="N",
+        help="number of case studies (default 1)",
+    )
+    pp.add_argument(
+        "--workers", type=int, default=1, metavar="N",
+        help="parallel workers (ideal packing; default 1)",
+    )
+    pp.add_argument(
+        "--platform", default=None, metavar="NAME",
+        help="target backend feature (e.g. cpu, tpu)",
+    )
+    pp.add_argument(
+        "--batch", type=float, default=None, metavar="N",
+        help="batch-size feature for the fit",
+    )
+    pp.add_argument(
+        "--index", default=None, metavar="DIR",
+        help="index directory (default: $TIP_OBS_INDEX or $TIP_ASSETS/obs/index)",
+    )
+    pp.add_argument("--json", action="store_true", help="machine-readable output")
+
+    tp = sub.add_parser(
+        "trend",
+        help="gate the last snapshot against median/MAD trend bands "
+        "(exit 0 ok / 1 regression / 2 bad input / 3 no baseline)",
+    )
+    tp.add_argument(
+        "targets",
+        nargs="+",
+        help="chronological snapshots, oldest first; the LAST is gated "
+        "(run dirs, bench records, BENCH_r*.json, summary --json files)",
+    )
+    tp.add_argument(
+        "--window", type=int, default=None, metavar="K",
+        help="non-degraded predecessors forming the baseline (default 5)",
+    )
+    tp.add_argument(
+        "--band", type=float, default=None, metavar="SIGMA",
+        help="band half-width in robust sigmas (default 3.0)",
+    )
+    tp.add_argument(
+        "--min-baseline", type=int, default=None, metavar="N",
+        help="fewer comparable predecessors than this exits 3 (default 3)",
+    )
+    tp.add_argument("--json", action="store_true", help="machine-readable output")
+
     args = ap.parse_args(argv)
 
     if args.command == "regress":
         return _regress(args)
+    if args.command == "runs":
+        return _runs(args)
+    if args.command == "predict":
+        return _predict(args)
+    if args.command == "trend":
+        return _trend(args)
 
     events, files, bad = load_events(args.target)
     if args.command == "summary":

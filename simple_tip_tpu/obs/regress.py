@@ -25,11 +25,22 @@ Regression rules (thresholds configurable from the CLI):
 - any growth in a health counter (worker deaths, timeouts, requeues,
   watchdog failures, cache corruption).
 
+obs v3 adds N-run **trend gating** (``trend`` + ``obs trend``): instead of
+one noisy pairwise diff, the current snapshot is gated against robust
+median/MAD bands computed over the last K comparable — non-degraded —
+predecessors. Degraded snapshots never enter a baseline (BENCH r02–r05
+would otherwise normalize the CPU fallback into "expected"), but a current
+degraded flip still regresses. ``select_bench_baseline`` applies the same
+policy to single-baseline selection: newest non-degraded ``BENCH_r*.json``,
+else the newest embedded ``last_good_tpu`` record, else an explicit
+``no_comparable_baseline`` verdict.
+
 Stdlib-only: this runs in the tier-0 CI gate.
 """
 
 import json
 import os
+import statistics
 
 #: Counters whose INCREASE between runs is a health regression. Matched as
 #: name prefixes so per-device / per-phase suffixes participate. The
@@ -54,6 +65,20 @@ DEFAULT_MAX_GROWTH = 0.25
 
 #: Phases shorter than this (seconds) in the baseline are noise, not signal.
 DEFAULT_MIN_SECONDS = 0.05
+
+#: Trend gate: how many comparable predecessors form the baseline window.
+DEFAULT_TREND_WINDOW = 5
+
+#: Trend gate: band half-width in robust sigmas (MAD x 1.4826).
+DEFAULT_TREND_BAND = 3.0
+
+#: Trend gate: minimum band half-width as a fraction of the median, so a
+#: perfectly-flat fixture history (MAD = 0) does not flag ppm-level jitter.
+DEFAULT_TREND_REL_FLOOR = 0.10
+
+#: Trend gate: fewer comparable predecessors than this is not a trend —
+#: the verdict is ``no_comparable_baseline`` (exit 3), not a pass/fail.
+DEFAULT_MIN_BASELINE = 3
 
 
 def _is_health_counter(name: str) -> bool:
@@ -264,14 +289,18 @@ def render(result: dict, baseline: dict, current: dict) -> str:
     return "\n".join(out)
 
 
-def bench_delta(current_record: dict, previous_path: str) -> dict:
+def bench_delta(
+    current_record: dict, previous_path: str, baseline_snapshot=None
+) -> dict:
     """``bench.py`` hook: the current record's delta vs a previous BENCH file.
 
-    Returns a JSON-safe summary to embed in the record (never raises —
-    bench's one-JSON-line contract outranks the companion).
+    ``baseline_snapshot`` (from ``select_bench_baseline``) skips re-loading
+    ``previous_path``; the path then only labels the comparison. Returns a
+    JSON-safe summary to embed in the record (never raises — bench's
+    one-JSON-line contract outranks the companion).
     """
     try:
-        baseline = load_snapshot(previous_path)
+        baseline = baseline_snapshot or load_snapshot(previous_path)
         current = _normalize_bench(current_record, "<current run>")
         result = compare(baseline, current)
         return {
@@ -289,3 +318,233 @@ def bench_delta(current_record: dict, previous_path: str) -> dict:
         }
     except Exception as e:  # noqa: BLE001 — companion data, never fatal
         return {"against": os.path.basename(str(previous_path)), "error": repr(e)[:200]}
+
+
+def select_bench_baseline(dirpath: str):
+    """The newest COMPARABLE bench baseline in ``dirpath``: ``(snap, note)``.
+
+    Scans ``BENCH_r*.json`` newest-first. The first non-degraded record
+    wins; failing that, the newest embedded ``last_good_tpu`` record (a
+    degraded wrapper carrying the pre-outage chip measurement) is promoted
+    to baseline with a note saying so; failing that, ``(None,
+    "no_comparable_baseline")``. A ``degraded: true`` record itself is
+    NEVER returned — comparing against the CPU fallback is how BENCH r05
+    passed review.
+    """
+    try:
+        rounds = sorted(
+            (
+                n
+                for n in os.listdir(dirpath)
+                if n.startswith("BENCH_r") and n.endswith(".json")
+            ),
+            reverse=True,
+        )
+    except OSError:
+        return None, "no_comparable_baseline"
+    last_good = None  # newest (doc, note) fallback seen so far
+    for name in rounds:
+        path = os.path.join(dirpath, name)
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+            doc = doc["parsed"]
+        if not isinstance(doc, dict) or "value" not in doc:
+            continue
+        if not doc.get("degraded", False):
+            return _normalize_bench(doc, path), name
+        lg = doc.get("last_good_tpu")
+        if (
+            last_good is None
+            and isinstance(lg, dict)
+            and isinstance(lg.get("value"), (int, float))
+            and not lg.get("degraded", False)
+        ):
+            last_good = (lg, f"last_good_tpu of {name}")
+    if last_good is not None:
+        doc, note = last_good
+        return _normalize_bench(doc, note), note
+    return None, "no_comparable_baseline"
+
+
+def _band(values, band: float, rel_floor: float):
+    """Robust ``(median, half_width)`` of a sample: MAD-sigma band.
+
+    The half-width is ``max(band * 1.4826 * MAD, rel_floor * |median|)`` —
+    the relative floor keeps a zero-variance history from flagging noise.
+    """
+    med = statistics.median(values)
+    mad = statistics.median(abs(v - med) for v in values)
+    return med, max(band * 1.4826 * mad, rel_floor * abs(med))
+
+
+def trend(
+    snapshots,
+    window: int = DEFAULT_TREND_WINDOW,
+    band: float = DEFAULT_TREND_BAND,
+    rel_floor: float = DEFAULT_TREND_REL_FLOOR,
+    min_seconds: float = DEFAULT_MIN_SECONDS,
+    min_baseline: int = DEFAULT_MIN_BASELINE,
+) -> dict:
+    """Gate the LAST snapshot against a trend over its predecessors.
+
+    ``snapshots`` is a chronological list (oldest first) of
+    ``load_snapshot`` dicts; the last one is under test. The baseline is
+    the last ``window`` NON-DEGRADED predecessors — degraded rows never
+    enter a baseline, but a current ``degraded: true`` still regresses
+    (flip gate). Per phase/metric the current value is compared against
+    robust median/MAD bands (``_band``): durations regress above the upper
+    band (if the median clears ``min_seconds``), the bench ``value``
+    regresses below the lower band (throughput: higher is better), and a
+    health counter regresses above the baseline window's max.
+
+    Returns ``{verdict, ok, rows, regressions, n_baseline, current}`` with
+    ``verdict`` one of ``ok`` / ``regression`` / ``no_comparable_baseline``
+    (fewer than ``min_baseline`` comparable predecessors — CI exit 3, a
+    skip, not a failure).
+    """
+    if not snapshots:
+        return {
+            "verdict": "no_comparable_baseline",
+            "ok": False,
+            "rows": [],
+            "regressions": [],
+            "n_baseline": 0,
+            "current": None,
+        }
+    current = snapshots[-1]
+    comparable = [s for s in snapshots[:-1] if s.get("degraded") is not True]
+    baseline = comparable[-window:]
+    if len(baseline) < min_baseline:
+        return {
+            "verdict": "no_comparable_baseline",
+            "ok": False,
+            "rows": [],
+            "regressions": [],
+            "n_baseline": len(baseline),
+            "current": current["source"],
+        }
+
+    rows = []
+
+    def row(kind, name, med, half, cur, regressed, note=""):
+        rows.append(
+            {
+                "kind": kind,
+                "name": name,
+                "median": med,
+                "band": half,
+                "current": cur,
+                "regressed": bool(regressed),
+                "note": note,
+            }
+        )
+
+    for name in sorted(current["phases"]):
+        cur = current["phases"][name]
+        history = [
+            s["phases"][name] for s in baseline if name in s["phases"]
+        ]
+        if len(history) < min_baseline:
+            row("phase", name, None, None, cur, False, "not enough history")
+            continue
+        med, half = _band(history, band, rel_floor)
+        if med < min_seconds:
+            row("phase", name, med, half, cur, False, "below noise floor")
+            continue
+        grew = cur > med + half
+        row(
+            "phase", name, med, half, cur, grew,
+            "above trend band" if grew else "",
+        )
+
+    if current["value"] is not None:
+        history = [
+            s["value"] for s in baseline if isinstance(s["value"], (int, float))
+        ]
+        if len(history) >= min_baseline:
+            med, half = _band(history, band, rel_floor)
+            dropped = current["value"] < med - half
+            row(
+                "bench", "value", med, half, current["value"], dropped,
+                "below trend band" if dropped else "",
+            )
+        else:
+            row(
+                "bench", "value", None, None, current["value"], False,
+                "not enough history",
+            )
+
+    if current["degraded"] is not None:
+        flip = current["degraded"] is True
+        row(
+            "bench", "degraded", False, None, current["degraded"], flip,
+            "degraded flip vs non-degraded baseline" if flip else "",
+        )
+
+    names = set(current["counters"])
+    for s in baseline:
+        names |= set(s["counters"])
+    for name in sorted(names):
+        if not _is_health_counter(name):
+            continue
+        cur = current["counters"].get(name, 0)
+        peak = max((s["counters"].get(name, 0) for s in baseline), default=0)
+        row(
+            "counter", name, peak, None, cur, cur > peak,
+            "above baseline-window max" if cur > peak else "",
+        )
+
+    regressions = [r for r in rows if r["regressed"]]
+    return {
+        "verdict": "regression" if regressions else "ok",
+        "ok": not regressions,
+        "rows": rows,
+        "regressions": regressions,
+        "n_baseline": len(baseline),
+        "current": current["source"],
+    }
+
+
+def render_trend(result: dict) -> str:
+    """A trend verdict as a deterministic text table."""
+    if result["verdict"] == "no_comparable_baseline":
+        return (
+            f"trend SKIPPED: no comparable baseline "
+            f"({result['n_baseline']} non-degraded predecessor(s), "
+            f"need {DEFAULT_MIN_BASELINE})"
+        )
+    out = [
+        f"current: {result['current']}  "
+        f"(baseline: {result['n_baseline']} non-degraded run(s))",
+        "",
+        f"  {'kind':<8} {'name':<40} {'median':>12} {'band':>10} "
+        f"{'current':>12}  verdict",
+    ]
+
+    def fmt(v):
+        if isinstance(v, bool) or v is None:
+            return str(v)
+        if isinstance(v, float):
+            return f"{v:.3f}"
+        return str(v)
+
+    for r in result["rows"]:
+        verdict = "REGRESSED" if r["regressed"] else "ok"
+        if r["note"]:
+            verdict += f" ({r['note']})"
+        out.append(
+            f"  {r['kind']:<8} {r['name']:<40} {fmt(r['median']):>12} "
+            f"{fmt(r['band']):>10} {fmt(r['current']):>12}  {verdict}"
+        )
+    out.append("")
+    n = len(result["regressions"])
+    out.append(
+        "trend OK: inside the band"
+        if not n
+        else f"trend FAILED: {n} regression(s) vs trend"
+    )
+    return "\n".join(out)

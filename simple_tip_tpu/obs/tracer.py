@@ -65,6 +65,12 @@ import time
 _lock = threading.RLock()
 _local = threading.local()
 
+#: Event-stream schema version, stamped into every stream's ``meta`` head
+#: line. Readers (``obs check``, the feature store) use it to reject rows
+#: they do not understand; the ``unversioned-schema`` tiplint rule enforces
+#: that every obs JSONL writer carries such a stamp.
+SCHEMA = 1
+
 # Resolved lazily on first use; _State.pid lets a forked child detect that it
 # inherited the parent's handle and must re-resolve (spawn re-imports anyway).
 _state = None
@@ -223,6 +229,7 @@ def _meta_event() -> dict:
     platform = os.environ.get("TIP_OBS_PLATFORM", "").strip()
     rec = {
         "type": "meta",
+        "schema": SCHEMA,
         "ts": time.time(),
         "pid": os.getpid(),
         "argv": list(sys.argv),

@@ -361,12 +361,32 @@ def run_phase_parallel(
     # inherits the parent environment) appends into the SAME run directory
     # and the streams merge across the spawn boundary.
     obs.enabled()
+    # Admission control (obs v3): quote the cost model's wall-clock estimate
+    # for this phase before launching, and stamp predicted_s next to the
+    # span's eventual actual_s so every executed study grades (and feeds)
+    # the corpus. Advisory by contract — no index, no estimate, no change.
+    from simple_tip_tpu.obs import costmodel as _costmodel
+
+    estimate = _costmodel.quick_phase_estimate(
+        phase, len(pending), workers=num_workers
+    )
+    predicted = {}
+    if estimate is not None:
+        predicted["predicted_s"] = estimate["predicted_s"]
+        logger.info(
+            "[%s] %s: cost model predicts %.1fs (+/- %.1fs, basis=%s, "
+            "corpus=%s rows) for %d runs on %d workers",
+            case_study, phase, estimate["predicted_s"],
+            estimate.get("error_s") or 0.0, estimate.get("basis"),
+            estimate.get("corpus_rows"), len(pending), num_workers,
+        )
     phase_span = obs.span(
         "scheduler.phase", phase=phase, case_study=case_study,
         runs=len(model_ids), workers=num_workers,
-        journal_skipped=len(skipped),
+        journal_skipped=len(skipped), **predicted,
     )
     phase_span.__enter__()
+    phase_started = time.perf_counter()
 
     ctx = mp.get_context("spawn")
     work_q = ctx.Queue()
@@ -580,6 +600,7 @@ def run_phase_parallel(
     phase_span.set(
         completed=sum(1 for e in results.values() if e is None),
         failed=sum(1 for e in results.values() if e is not None),
+        actual_s=round(time.perf_counter() - phase_started, 3),
     ).__exit__(None, None, None)
     # Final high-water sample even for phases shorter than the poll period.
     if obs.enabled():

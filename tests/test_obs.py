@@ -725,3 +725,347 @@ def test_cli_export_via_module_entrypoint(tmp_path):
     assert r.returncode == 0, r.stderr
     doc = json.loads(out.read_text())
     assert doc["traceEvents"]
+
+
+# --- trend gating (obs v3) ---------------------------------------------------
+
+TREND_FIXTURE = os.path.join(REPO_ROOT, "tests", "fixtures", "obs_trend")
+
+
+def _trend_targets(*names):
+    return [os.path.join(TREND_FIXTURE, n) for n in names]
+
+
+STABLE = ("t01_stable.json", "t02_stable.json", "t03_stable.json", "t04_stable.json")
+
+
+def test_trend_stable_prefix_exits_zero(capsys):
+    assert main(["trend", *_trend_targets(*STABLE)]) == 0
+    assert "trend OK" in capsys.readouterr().out
+
+
+def test_trend_drift_exits_one(capsys):
+    rc = main(["trend", *_trend_targets(*STABLE, "t05_drift.json"), "--json"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["verdict"] == "regression"
+    regressed = {r["name"] for r in doc["regressions"]}
+    # both the throughput drop and the sa_fit slowdown cross their bands
+    assert "value" in regressed
+    assert "sa_fit.total" in regressed
+
+
+def test_trend_degraded_flip_exits_one(capsys):
+    rc = main(
+        ["trend", *_trend_targets(*STABLE, "t05_drift.json", "t06_degraded.json"),
+         "--json"]
+    )
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert {"degraded", "value"} <= {r["name"] for r in doc["regressions"]}
+
+
+def test_trend_degraded_rows_never_enter_the_baseline(capsys):
+    # t06 (degraded) sits mid-history: the baseline must skip it entirely,
+    # leaving the three stable predecessors — NOT four snapshots.
+    rc = main(
+        ["trend",
+         *_trend_targets("t01_stable.json", "t02_stable.json", "t03_stable.json",
+                         "t06_degraded.json", "t04_stable.json"),
+         "--json"]
+    )
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["n_baseline"] == 3
+
+
+def test_trend_thin_history_exits_three(capsys):
+    rc = main(["trend", *_trend_targets("t01_stable.json", "t02_stable.json")])
+    assert rc == 3
+    assert "no comparable baseline" in capsys.readouterr().out
+
+
+def test_trend_all_degraded_history_exits_three():
+    targets = _trend_targets(*(("t06_degraded.json",) * 4), "t04_stable.json")
+    assert main(["trend", *targets]) == 3
+
+
+def test_trend_bad_input_exits_two(tmp_path, capsys):
+    bad = tmp_path / "garbage.json"
+    bad.write_text("{not json")
+    rc = main(["trend", *_trend_targets(*STABLE), str(bad)])
+    assert rc == 2
+
+
+def test_regress_without_newer_bench_exits_three(tmp_path, monkeypatch, capsys):
+    # Only the baseline itself exists in cwd: "nothing comparable" is a
+    # skip (3), distinct from a regression (1) and bad input (2).
+    base = os.path.join(REGRESS_FIXTURE, "bench_base.json")
+    monkeypatch.chdir(tmp_path)
+    assert main(["regress", "--against", base]) == 3
+
+
+# --- bench baseline selection (obs v3) ---------------------------------------
+
+
+def _write_bench(dirpath, name, value, degraded, last_good=None):
+    rec = {
+        "metric": "prioritizer_inputs_per_sec_per_chip",
+        "value": value,
+        "degraded": degraded,
+        "sa_fit_seconds": {"total": 6.2, "by_variant": {"dsa": 1.1}},
+    }
+    if last_good is not None:
+        rec["last_good_tpu"] = last_good
+    with open(os.path.join(dirpath, name), "w", encoding="utf-8") as f:
+        json.dump({"n": 1, "rc": 0, "parsed": rec}, f)
+
+
+def test_select_bench_baseline_prefers_newest_non_degraded(tmp_path):
+    from simple_tip_tpu.obs.regress import select_bench_baseline
+
+    _write_bench(str(tmp_path), "BENCH_r01.json", 3_000_000.0, False)
+    _write_bench(str(tmp_path), "BENCH_r02.json", 3_100_000.0, False)
+    _write_bench(str(tmp_path), "BENCH_r03.json", 6_000.0, True)
+    snap, note = select_bench_baseline(str(tmp_path))
+    assert note == "BENCH_r02.json"
+    assert snap["value"] == 3_100_000.0
+    assert snap["degraded"] is False
+
+
+def test_select_bench_baseline_falls_back_to_last_good_tpu(tmp_path):
+    from simple_tip_tpu.obs.regress import select_bench_baseline
+
+    lg = {"metric": "prioritizer_inputs_per_sec_per_chip",
+          "value": 3_185_903.4, "degraded": False}
+    _write_bench(str(tmp_path), "BENCH_r01.json", 6_100.0, True)
+    _write_bench(str(tmp_path), "BENCH_r02.json", 6_280.0, True, last_good=lg)
+    snap, note = select_bench_baseline(str(tmp_path))
+    assert note == "last_good_tpu of BENCH_r02.json"
+    assert snap["value"] == pytest.approx(3_185_903.4)
+    assert snap["degraded"] is False
+
+
+def test_select_bench_baseline_never_returns_degraded(tmp_path):
+    # All-degraded history with no embedded good record: explicit skip —
+    # the BENCH_r05 failure mode (degraded baseline) is unrepresentable.
+    from simple_tip_tpu.obs.regress import select_bench_baseline
+
+    for i in range(1, 4):
+        _write_bench(str(tmp_path), f"BENCH_r0{i}.json", 6_000.0 + i, True)
+    snap, note = select_bench_baseline(str(tmp_path))
+    assert snap is None
+    assert note == "no_comparable_baseline"
+
+
+def test_select_bench_baseline_on_real_repo_history():
+    # The committed r01–r05 trajectory: r02–r05 are degraded CPU records,
+    # r01 has parsed: null, and the only chip number rides r05's
+    # last_good_tpu — selection must surface exactly that.
+    from simple_tip_tpu.obs.regress import select_bench_baseline
+
+    snap, note = select_bench_baseline(REPO_ROOT)
+    assert snap is not None and snap["degraded"] is False
+    assert note == "last_good_tpu of BENCH_r05.json"
+    assert snap["value"] == pytest.approx(3185903.4)
+
+
+def test_bench_delta_accepts_prebuilt_baseline_snapshot():
+    from simple_tip_tpu.obs.regress import bench_delta, load_snapshot
+
+    baseline = load_snapshot(os.path.join(REGRESS_FIXTURE, "bench_base.json"))
+    current = json.load(
+        open(os.path.join(REGRESS_FIXTURE, "bench_degraded.json"))
+    )
+    delta = bench_delta(current, "label-only.json", baseline_snapshot=baseline)
+    assert delta["ok"] is False
+    assert delta["against"] == "label-only.json"
+    assert {r["name"] for r in delta["regressions"]} >= {"value", "degraded"}
+
+
+# --- feature store (obs v3) --------------------------------------------------
+
+
+def test_store_builds_schema_stamped_index(tmp_path):
+    from simple_tip_tpu.obs import store
+
+    idx = str(tmp_path / "index")
+    report = store.refresh([TREND_FIXTURE, FIXTURE], idx)
+    assert report["rows_appended"] > 0
+    rows = store.load_rows(idx)
+    assert rows and all(r["schema"] == store.SCHEMA for r in rows)
+    kinds = {r["kind"] for r in rows}
+    assert {"bench", "obs_run"} <= kinds
+    # the degraded fixture's rows carry the flag the cost model filters on
+    degraded = [r for r in rows if r["source"].endswith("t06_degraded.json")]
+    assert degraded and all(r["degraded"] is True for r in degraded)
+    # bench value and sa_fit phase rows both exist per record
+    t01 = [r for r in rows if r["source"].endswith("t01_stable.json")]
+    assert {"sa_fit.total", "sa_fit.dsa", "sa_fit.pc-lsa"} <= {
+        r["phase"] for r in t01
+    }
+    assert any(r["value"] == pytest.approx(3150000.0) for r in t01)
+
+
+def test_store_refresh_is_incremental(tmp_path):
+    from simple_tip_tpu.obs import store
+
+    src = tmp_path / "runs"
+    src.mkdir()
+    _write_bench(str(src), "BENCH_r01.json", 1_000.0, False)
+    idx = str(tmp_path / "index")
+    first = store.refresh([str(src)], idx)
+    assert len(first["indexed"]) == 1
+    second = store.refresh([str(src)], idx)
+    assert second["indexed"] == [] and second["skipped"] == 1
+    assert second["rows_appended"] == 0
+    # a changed source re-indexes under a higher seq; readers keep only the
+    # newest batch, so the row count does not double
+    _write_bench(str(src), "BENCH_r01.json", 2_000.0, False)
+    third = store.refresh([str(src)], idx)
+    assert len(third["indexed"]) == 1
+    rows = store.load_rows(idx)
+    values = [r["value"] for r in rows if r["value"] is not None]
+    assert values == [2_000.0]
+
+
+def test_store_index_dir_env_override(tmp_path, monkeypatch):
+    from simple_tip_tpu.obs import store
+
+    monkeypatch.setenv("TIP_OBS_INDEX", str(tmp_path / "custom"))
+    assert store.default_index_dir() == str(tmp_path / "custom")
+    monkeypatch.delenv("TIP_OBS_INDEX")
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "assets"))
+    assert store.default_index_dir() == str(
+        tmp_path / "assets" / "obs" / "index"
+    )
+
+
+def test_store_normalizes_obs_run_spans(tmp_path):
+    from simple_tip_tpu.obs import store
+
+    idx = str(tmp_path / "index")
+    store.refresh([FIXTURE], idx)
+    rows = [r for r in store.load_rows(idx) if r["kind"] == "obs_run"]
+    assert rows
+    by_phase = {r["phase"] for r in rows}
+    # the committed fixture trace is scheduler-shaped: its span names land
+    # as phase aggregates
+    assert any(p.startswith("scheduler.") or p for p in by_phase)
+    assert all(isinstance(r["seconds"], float) for r in rows)
+
+
+def test_runs_cli_builds_and_prints_index(tmp_path, capsys):
+    idx = str(tmp_path / "index")
+    rc = main(["runs", TREND_FIXTURE, "--index", idx])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "rows:" in out and "t05_drift" in out
+
+
+# --- cost model (obs v3) -----------------------------------------------------
+
+
+def _corpus_rows(n, phase="test_prio", seconds=10.0, platform="cpu"):
+    from simple_tip_tpu.obs import store
+
+    rows = []
+    for i in range(n):
+        row = store._blank_row("obs_run", f"run{i}", i + 1)
+        row["phase"] = phase
+        row["seconds"] = seconds + 0.1 * i
+        row["platform"] = platform
+        rows.append(row)
+    return rows
+
+
+def test_costmodel_fit_and_predict(tmp_path):
+    from simple_tip_tpu.obs import costmodel
+
+    model = costmodel.fit(_corpus_rows(6))
+    entry = model["phases"]["test_prio"]
+    assert entry["sufficient"] and entry["coef"] is not None
+    result = costmodel.predict_study(
+        model, ["test_prio"], runs=100, case_studies=2, platform="cpu",
+        workers=4,
+    )
+    assert result["ok"]
+    info = result["by_phase"]["test_prio"]
+    assert info["basis"] == "model"
+    # 200 runs of ~10.25s over 4 ideal workers ~ 512s
+    assert result["total_s"] == pytest.approx(200 * 10.25 / 4, rel=0.1)
+    assert result["error_s"] >= 0
+
+
+def test_costmodel_degraded_rows_never_train():
+    from simple_tip_tpu.obs import costmodel
+
+    rows = _corpus_rows(6)
+    poisoned = _corpus_rows(6, seconds=9999.0)
+    for r in poisoned:
+        r["degraded"] = True
+    model = costmodel.fit(rows + poisoned)
+    per_run, _err, basis = costmodel.phase_estimate(
+        model, "test_prio", platform="cpu"
+    )
+    assert basis == "model"
+    assert per_run < 100  # the degraded 9999s rows left no trace
+
+
+def test_costmodel_insufficient_corpus_is_loud():
+    from simple_tip_tpu.obs import costmodel
+
+    model = costmodel.fit(_corpus_rows(2))  # below DEFAULT_MIN_ROWS
+    result = costmodel.predict_study(model, ["test_prio"], runs=10)
+    assert result["by_phase"]["test_prio"]["basis"] == "median"
+    assert "test_prio" in result["insufficient"]
+    assert result["ok"]  # a median fallback is still an estimate
+    nothing = costmodel.predict_study(model, ["never_ran"], runs=10)
+    assert nothing["ok"] is False
+    assert nothing["by_phase"]["never_ran"]["basis"] == "missing"
+
+
+def test_predict_cli_states_error_and_exit_codes(tmp_path, capsys):
+    idx = str(tmp_path / "index")
+    assert main(["runs", TREND_FIXTURE, "--index", idx]) == 0
+    capsys.readouterr()
+    rc = main(
+        ["predict", "--phases", "sa_fit.total", "--runs", "100",
+         "--case-studies", "4", "--workers", "2", "--platform", "tpu",
+         "--batch", "8192", "--index", idx]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "predicted wall-clock" in out and "+/-" in out
+    # empty index: exit 3, not a crash and not a zero estimate
+    assert main(
+        ["predict", "--phases", "sa_fit.total", "--index",
+         str(tmp_path / "void")]
+    ) == 3
+    # corpus exists but no requested phase does: exit 3 with the loud note
+    rc = main(["predict", "--phases", "never_ran", "--index", idx, "--json"])
+    assert rc == 3
+
+
+def test_quick_phase_estimate_is_failure_safe(tmp_path, monkeypatch):
+    from simple_tip_tpu.obs import costmodel
+
+    monkeypatch.setenv("TIP_OBS_INDEX", str(tmp_path / "nowhere"))
+    assert costmodel.quick_phase_estimate("test_prio", 10) is None
+
+
+def test_quick_phase_estimate_predicts_from_index(tmp_path):
+    from simple_tip_tpu.obs import costmodel, store
+
+    idx = str(tmp_path / "index")
+    rows_path = os.path.join(idx, "index.jsonl")
+    os.makedirs(idx, exist_ok=True)
+    with open(rows_path, "w", encoding="utf-8") as f:
+        for row in _corpus_rows(5):
+            f.write(json.dumps(row) + "\n")
+    est = costmodel.quick_phase_estimate(
+        "test_prio", 10, platform="cpu", workers=2, index_dir=idx
+    )
+    assert est is not None
+    assert est["basis"] == "model"
+    assert est["predicted_s"] == pytest.approx(10 * 10.2 / 2, rel=0.1)
+    assert store.load_rows(idx)  # the hand-written rows are schema-valid

@@ -673,6 +673,54 @@ while True:
 ''',
 }
 
+BAD_UNVERSIONED_SCHEMA = {
+    "obs/sink.py": '''"""m."""
+import json
+
+
+def append_row(fh, rec):
+    """The classic JSONL idiom, but nothing stamps a schema field."""
+    fh.write(json.dumps(rec) + "\\n")
+
+
+def append_rows(fh, recs):
+    """Line-joined batch write, same problem."""
+    fh.write("\\n".join(json.dumps(r) for r in recs))
+''',
+}
+
+GOOD_UNVERSIONED_SCHEMA = {
+    "obs/sink.py": '''"""m."""
+import json
+
+SCHEMA = 1
+
+
+def append_row(fh, payload):
+    """Stamped row: the dict literal carries the schema version."""
+    rec = {"schema": SCHEMA, "payload": payload}
+    fh.write(json.dumps(rec) + "\\n")
+''',
+    # Same writes OUTSIDE an obs/ package: out of the rule's scope.
+    "io/sink.py": '''"""m."""
+import json
+
+
+def append_row(fh, rec):
+    """Not obs-owned JSONL; other contracts govern it."""
+    fh.write(json.dumps(rec) + "\\n")
+''',
+    # dumps without a line sink (CLI output) is not a JSONL write site.
+    "obs/report.py": '''"""m."""
+import json
+
+
+def render(doc):
+    """A whole document, replaced atomically — not an appended row."""
+    return json.dumps(doc, indent=2)
+''',
+}
+
 FIXTURES = {
     "jit-purity": (BAD_JIT_PURITY, GOOD_JIT_PURITY),
     "naked-retry": (BAD_NAKED_RETRY, GOOD_NAKED_RETRY),
@@ -687,6 +735,7 @@ FIXTURES = {
     "sharding-spec-mismatch": (BAD_SHARDING, GOOD_SHARDING),
     "shape-polymorphism": (BAD_SHAPE_POLY, GOOD_SHAPE_POLY),
     "transitive-jit-purity": (BAD_TRANSITIVE, GOOD_TRANSITIVE),
+    "unversioned-schema": (BAD_UNVERSIONED_SCHEMA, GOOD_UNVERSIONED_SCHEMA),
 }
 
 
@@ -761,6 +810,31 @@ def test_shape_poly_finds_each_escape(tmp_path):
     blob = " ".join(f.message for f in findings)
     for marker in ("`if`", "`for`", "len(x)", "reshape(8, 16)"):
         assert marker in blob, f"missing {marker!r} in: {blob}"
+
+
+def test_unversioned_schema_flags_each_write_site(tmp_path):
+    findings = _run_rule(tmp_path, "unversioned-schema", BAD_UNVERSIONED_SCHEMA)
+    assert len(findings) == 2, findings  # concat write + line-joined batch
+    assert all(f.path == "obs/sink.py" for f in findings)
+    assert all("schema" in f.message for f in findings)
+
+
+def test_unversioned_schema_accepts_subscript_stamp(tmp_path):
+    # rec["schema"] = SCHEMA (the retrofit idiom) also satisfies the rule.
+    files = {
+        "obs/sink.py": '''"""m."""
+import json
+
+SCHEMA = 2
+
+
+def append_row(fh, rec):
+    """Stamp via subscript store instead of a dict literal."""
+    rec["schema"] = SCHEMA
+    fh.write(json.dumps(rec) + "\\n")
+''',
+    }
+    assert not _run_rule(tmp_path, "unversioned-schema", files)
 
 
 def test_transitive_chain_spans_modules(tmp_path):
