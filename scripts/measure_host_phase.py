@@ -41,6 +41,72 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 SA_ORDER = ("dsa", "pc-lsa", "pc-mdsa", "pc-mlsa", "pc-mmdsa")
 
 
+def _stamp_health(record: dict) -> None:
+    """Stamp ``degraded`` + breaker snapshot the way bench.py does.
+
+    Unlike bench, cpu is NOT a degradation here — this script PINS the cpu
+    platform on purpose (it measures the host share). Degraded means the
+    watchdog or breaker reported a real failure during the capture; the
+    flag makes `obs trend` skip the capture as a baseline and flag the
+    flip, same as for bench records.
+    """
+    from simple_tip_tpu.resilience import CircuitBreaker
+    from simple_tip_tpu.utils.device_watchdog import degradation_reason
+
+    reason = degradation_reason()
+    record["degraded"] = bool(reason)
+    if reason:
+        record["degraded_reason"] = reason
+    breaker = CircuitBreaker.from_env()
+    if breaker is not None:
+        record["breaker"] = breaker.snapshot()
+
+
+def _append_history(record: dict) -> None:
+    """Append THIS capture's headline numbers to the record's history, so
+    the trajectory (not just the latest value) rides in the artifact and
+    `obs runs` / `obs trend` can gate it."""
+    history = record.setdefault("history", {})
+    history[f"capture_{record['captured_unix']}"] = {
+        "test_prio_s": record.get("test_prio_s"),
+        "train_1epoch_s": record.get("train_1epoch_s"),
+        "degraded": record.get("degraded"),
+    }
+
+
+def _cov_stage(cs, model_id: int, cache_dir: str, label: str) -> dict:
+    """One CoverageWorker construction (= the coverage train-stats pass)
+    against the coverage-stats disk cache.
+
+    Returns the cache outcome plus the NBC debit (NBC carries the full
+    min+max+welford+pred share, so it bounds the per-process stats cost the
+    cache amortizes).
+    """
+    from simple_tip_tpu.engine.coverage_handler import CoverageWorker
+    from simple_tip_tpu.engine.model_handler import BaseModel
+
+    os.environ["TIP_COV_STATS_CACHE_DIR"] = cache_dir
+    (x_train, _), _, _ = cs.spec.loader()
+    params = cs.load_params(model_id)
+    t0 = time.time()
+    worker = CoverageWorker(
+        base_model=BaseModel(
+            cs.scoring_model_def,
+            params,
+            activation_layers=list(cs.spec.nc_activation_layers),
+            batch_size=cs.spec.prediction_badge_size,
+        ),
+        training_set=x_train,
+    )
+    out = {
+        "outcome": worker.stats_cache_outcome,
+        "debit_s": round(max(worker.setup_times.values()), 2),
+        "wall_s": round(time.time() - t0, 1),
+    }
+    print(f"coverage stats ({label}): {out}", flush=True)
+    return out
+
+
 def _sa_stage(cs, model_id: int, cache_dir: str, label: str) -> dict:
     """One SurpriseHandler.evaluate_all pass at the loaded shapes.
 
@@ -94,14 +160,23 @@ def main() -> int:
     ap.add_argument(
         "--sa-only",
         action="store_true",
-        help="measure only the SA fit stage (cold + warm cache) and merge "
-        "into the existing record — no full prio phase",
+        help="measure only the SA fit stage (cold + warm cache) and the "
+        "coverage train-stats stage, and merge into the existing record — "
+        "no full prio phase",
+    )
+    ap.add_argument(
+        "--scale",
+        choices=("paper", "small"),
+        default="paper",
+        help="synthetic data scale: paper (the real measurement) or small "
+        "(a smoke capture — minutes, not hours; numbers are NOT the paper "
+        "claim)",
     )
     args = ap.parse_args()
 
     os.environ["TIP_ASSETS"] = args.assets
     os.environ.setdefault("TIP_DATA_DIR", "/tmp/host_phase_none")
-    os.environ["TIP_SYNTH_SCALE"] = "paper"
+    os.environ["TIP_SYNTH_SCALE"] = args.scale
     # Telemetry on by default (TIP_ASSETS is set just above, so `auto`
     # lands under this measurement's own assets dir); TIP_OBS_DIR=off
     # opts out. The measured stages become spans under one study root, so
@@ -147,11 +222,14 @@ def main() -> int:
         with open(args.out) as f:
             prev = json.load(f)
         record["history"] = prev.get("history", {})
-        prev_key = f"prior_capture_{prev.get('captured_unix', 'unknown')}"
-        record["history"][prev_key] = {
-            "test_prio_s": prev.get("test_prio_s"),
-            "train_1epoch_s": prev.get("train_1epoch_s"),
-        }
+        ts = prev.get("captured_unix", "unknown")
+        # the previous capture already recorded itself under capture_<ts>;
+        # don't duplicate it as a prior_capture_ entry
+        if f"capture_{ts}" not in record["history"]:
+            record["history"][f"prior_capture_{ts}"] = {
+                "test_prio_s": prev.get("test_prio_s"),
+                "train_1epoch_s": prev.get("train_1epoch_s"),
+            }
     except (OSError, ValueError):
         pass
     t0 = time.time()
@@ -208,13 +286,27 @@ def main() -> int:
                 "carried over from the previous record."
             ),
         }
+        cov_cache_dir = os.path.join(args.assets, "cov_stats_cache_measure")
+        shutil.rmtree(cov_cache_dir, ignore_errors=True)
+        record["cov_stats"] = {
+            "cold": _cov_stage(cs, 0, cov_cache_dir, "cold"),
+            "warm": _cov_stage(cs, 0, cov_cache_dir, "warm"),
+            "note": (
+                "cold = fresh coverage train-stats pass (cache miss + "
+                "store); warm = second CoverageWorker against the cache "
+                "the cold pass wrote — the per-scheduler-process debit "
+                "the cache amortizes (engine/coverage_stats_cache.py)"
+            ),
+        }
         record["captured_unix"] = round(time.time(), 1)
+        _stamp_health(record)
+        _append_history(record)
         from simple_tip_tpu.utils.artifacts_io import atomic_write_json
 
         atomic_write_json(args.out, record)
         study_span.__exit__(None, None, None)
         obs.flush_metrics()
-        print(json.dumps(record["sa_setup"]))
+        print(json.dumps({"sa_setup": record["sa_setup"], "cov_stats": record["cov_stats"]}))
         return 0
 
     # Fresh SA fits for the measured phase: a warm cache from an earlier
@@ -274,6 +366,8 @@ def main() -> int:
     )
 
     record["captured_unix"] = round(time.time(), 1)
+    _stamp_health(record)
+    _append_history(record)
     from simple_tip_tpu.utils.artifacts_io import atomic_write_json
 
     atomic_write_json(args.out, record)

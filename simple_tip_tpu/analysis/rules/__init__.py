@@ -12,6 +12,7 @@ from simple_tip_tpu.analysis.rules import (  # noqa: F401
     docstring_coverage,
     f64_on_tpu,
     host_sync,
+    implicit_transfer,
     jit_purity,
     naked_retry,
     prng_hygiene,

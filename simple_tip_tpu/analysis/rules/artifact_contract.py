@@ -28,9 +28,10 @@ Findings:
 
 Exempt buses: ``results`` (terminal plot/table output), ``models``
 (engine-internal checkpoints), ``activations``/``.tmp`` (engine-internal
-spill, bounded and self-consumed), ``sa_fit_cache`` (engine-internal
-fitted-scorer cache, written AND read by the engine across processes —
-engine/sa_prep.py; plotters never touch it).
+spill, bounded and self-consumed), ``sa_fit_cache`` and
+``coverage_stats_cache`` (engine-internal cross-process caches, written AND
+read by the engine — engine/sa_prep.py and engine/coverage_stats_cache.py;
+plotters never touch them).
 """
 
 import ast
@@ -41,7 +42,14 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
 from simple_tip_tpu.analysis.rules.common import callee_name, import_aliases, parent_map
 
-EXEMPT_BUSES = {"results", "models", "activations", ".tmp", "sa_fit_cache"}
+EXEMPT_BUSES = {
+    "results",
+    "models",
+    "activations",
+    ".tmp",
+    "sa_fit_cache",
+    "coverage_stats_cache",
+}
 WRITER_PREFIXES = ("engine/",)
 READER_PREFIXES = ("plotters/", "utils/")
 ARTIFACT_SUFFIXES = {".npy", ".pickle", ".pkl", ".msgpack"}

@@ -1,0 +1,165 @@
+"""Rule ``implicit-device-transfer``: name-dataflow device→host syncs in
+engine scoring paths.
+
+The ``host-sync`` rule flags ``np.asarray(<expr containing jnp>)`` — the
+conversion and the device computation in ONE expression. The pattern that
+actually crept into engine scoring code is the two-step form::
+
+    scores = _score_fn(badge)          # _score_fn = jax.jit(...)
+    out.append(np.asarray(scores))     # per-badge device->host sync
+
+The argument is a bare name, so the expression-local check never sees the
+device value. This rule tracks that one level of dataflow per scope: a name
+assigned from a jnp-building expression, from a call to a locally-jitted
+function, or from another tainted name is tainted; passing a tainted name to
+``np.asarray``/``np.array``/``np.ascontiguousarray`` flags. Re-binding a
+name to a host expression untaints it.
+
+Scoped to ``engine/`` only (the prio scoring paths this PR made
+device-resident): ops/ converts at kernel boundaries by design and carries
+audited host-sync suppressions, and attribute calls
+(``self._fused_fn(...)``) are deliberately NOT tracked — the coverage
+badge-pull is an intentional, documented accumulation point.
+"""
+
+import ast
+from typing import Dict, Iterator, Set, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.common import (
+    _transform_target,
+    callee_name,
+    contains_jnp,
+    import_aliases,
+    jit_reachable_functions,
+)
+
+_CONVERTERS = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
+
+_SCOPE_PREFIX = "engine/"
+
+
+@register
+class ImplicitTransferRule(Rule):
+    """Flag np.asarray/np.array on names holding device values in engine/."""
+
+    name = "implicit-device-transfer"
+    description = (
+        "np.asarray/np.array on a NAME assigned from a jnp expression or a "
+        "locally-jitted call in engine/ scoring paths (the dataflow "
+        "complement of host-sync's expression-local check)"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Track one level of device-value dataflow per scope and flag
+        host conversions of tainted names."""
+        if not module.relpath.startswith(_SCOPE_PREFIX):
+            return
+        aliases = import_aliases(module.tree)
+
+        jitted: Set[str] = set()
+        for fn in jit_reachable_functions(module.tree, aliases):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                jitted.add(fn.name)
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and _transform_target(node.value.func, aliases)
+            ):
+                jitted.add(node.targets[0].id)
+
+        scopes = [module.tree.body] + [
+            fn.body
+            for fn in ast.walk(module.tree)
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for body in scopes:
+            yield from self._scan(body, aliases, jitted, set())
+
+    def _is_device_expr(
+        self,
+        expr: ast.AST,
+        aliases: Dict[str, str],
+        jitted: Set[str],
+        tainted: Set[str],
+    ) -> bool:
+        """Does this RHS produce a device value (one dataflow level)?"""
+        if isinstance(expr, ast.Name):
+            return expr.id in tainted
+        if isinstance(expr, ast.Call):
+            name = callee_name(expr, aliases)
+            if name in jitted:
+                return True
+        return contains_jnp(expr, aliases) is not None
+
+    def _flag_calls(
+        self, node: ast.AST, aliases: Dict[str, str], tainted: Set[str]
+    ) -> Iterator[Tuple[str, int, str]]:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = callee_name(sub, aliases)
+            if (
+                name in _CONVERTERS
+                and sub.args
+                and isinstance(sub.args[0], ast.Name)
+                and sub.args[0].id in tainted
+            ):
+                yield "", sub.lineno, (
+                    f"{name.replace('numpy', 'np')}({sub.args[0].id}) syncs a "
+                    "device value produced earlier in this scope: implicit "
+                    "device->host transfer; keep scoring device-resident and "
+                    "transfer once at the phase boundary"
+                )
+
+    def _scan(
+        self,
+        stmts,
+        aliases: Dict[str, str],
+        jitted: Set[str],
+        tainted: Set[str],
+    ) -> Iterator[Tuple[str, int, str]]:
+        """Source-order walk of one scope's statements, skipping nested
+        function/class bodies (they scan as their own scopes)."""
+        for stmt in stmts:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+            ):
+                yield from self._flag_calls(stmt.value, aliases, tainted)
+                if self._is_device_expr(stmt.value, aliases, jitted, tainted):
+                    tainted.add(stmt.targets[0].id)
+                else:
+                    tainted.discard(stmt.targets[0].id)
+                continue
+            bodies = [
+                getattr(stmt, field)
+                for field in ("body", "orelse", "finalbody")
+                if isinstance(getattr(stmt, field, None), list)
+            ]
+            bodies += [h.body for h in getattr(stmt, "handlers", []) or []]
+            if bodies and any(
+                b and isinstance(b[0], ast.stmt) for b in bodies
+            ):
+                # compound statement: flag its header expressions, then
+                # recurse into each body in source order (loop-body taint
+                # carries to later statements of the same body)
+                for field, value in ast.iter_fields(stmt):
+                    if field in ("body", "orelse", "finalbody", "handlers"):
+                        continue
+                    values = value if isinstance(value, list) else [value]
+                    for v in values:
+                        if isinstance(v, ast.AST):
+                            yield from self._flag_calls(v, aliases, tainted)
+                for b in bodies:
+                    yield from self._scan(b, aliases, jitted, tainted)
+            else:
+                yield from self._flag_calls(stmt, aliases, tainted)

@@ -98,25 +98,67 @@ class CoverageWorker:
         # Random token avoids temp-dir collisions between concurrent runs.
         self.temp_random = str(secrets.token_urlsafe(16))
 
-        agg_stats = DeviceAggregateStatisticsCollector()
-        with obs.span("coverage.train_stats_pass", samples=len(training_set)):
-            pred_timer = Timer(start=True)
-            for activations in base_model.walk_activations(
-                training_set, badge_size=PROFILE_BADGE_SIZE, device=True
-            ):
-                pred_timer.stop()
-                agg_stats.track(activations)
-                pred_timer.start()
-            pred_timer.stop()
+        # The train-stats pass is a pure function of (params, train set, tap
+        # layers) but was recomputed by every scheduler process; the disk
+        # cache amortizes it to once per study. On a hit, every consuming
+        # metric's debit is the LOAD time (the same full-debit-per-metric
+        # accounting the recompute path uses), and the train walk is skipped
+        # entirely.
+        from simple_tip_tpu.engine.coverage_stats_cache import CoverageStatsCache
 
-        mins, maxs, std = agg_stats.get()
-
-        nbc_debit = (
-            agg_stats.min_timer.get()
-            + agg_stats.max_timer.get()
-            + pred_timer.get()
-            + agg_stats.welford_timer.get()
+        stats_cache = CoverageStatsCache.from_env(
+            base_model.params, training_set, base_model.activation_layers
         )
+        self.stats_cache_outcome = "off" if stats_cache is None else "miss"
+        cached_stats = None
+        load_timer = Timer()
+        if stats_cache is not None:
+            with load_timer:
+                cached_stats = stats_cache.load()
+
+        if cached_stats is not None:
+            self.stats_cache_outcome = "hit"
+            mins, maxs, std = cached_stats
+            with obs.span(
+                "coverage.train_stats_pass", samples=len(training_set)
+            ) as span:
+                span.set(cached=True, load_s=round(load_timer.get(), 6))
+            nbc_debit = snac_debit = kmnc_debit = load_timer.get()
+        else:
+            agg_stats = DeviceAggregateStatisticsCollector()
+            with obs.span(
+                "coverage.train_stats_pass", samples=len(training_set)
+            ) as span:
+                span.set(cached=False)
+                pred_timer = Timer(start=True)
+                for activations in base_model.walk_activations(
+                    training_set, badge_size=PROFILE_BADGE_SIZE, device=True
+                ):
+                    pred_timer.stop()
+                    agg_stats.track(activations)
+                    pred_timer.start()
+                pred_timer.stop()
+
+            mins, maxs, std = agg_stats.get()
+            if stats_cache is not None:
+                stats_cache.store((mins, maxs, std))
+
+            nbc_debit = (
+                agg_stats.min_timer.get()
+                + agg_stats.max_timer.get()
+                + pred_timer.get()
+                + agg_stats.welford_timer.get()
+            )
+            snac_debit = (
+                agg_stats.welford_timer.get()
+                + agg_stats.max_timer.get()
+                + pred_timer.get()
+            )
+            kmnc_debit = (
+                agg_stats.min_timer.get()
+                + agg_stats.max_timer.get()
+                + pred_timer.get()
+            )
         for scaler in (0, 0.5, 1):
             self._add_metric(
                 f"NBC_{scaler}",
@@ -124,9 +166,6 @@ class CoverageWorker:
                 time_debit=nbc_debit,
             )
 
-        snac_debit = (
-            agg_stats.welford_timer.get() + agg_stats.max_timer.get() + pred_timer.get()
-        )
         for scaler in (0, 0.5, 1):
             self._add_metric(
                 f"SNAC_{scaler}",
@@ -140,9 +179,6 @@ class CoverageWorker:
         for k in (1, 2, 3):
             self._add_metric(f"TKNC_{k}", lambda kk=k: TKNC(top_neurons=kk))
 
-        kmnc_debit = (
-            agg_stats.min_timer.get() + agg_stats.max_timer.get() + pred_timer.get()
-        )
         # KMNC_1000/KMNC_10000 from the DeepGini paper are too expensive; the
         # reference (and we) use KMNC_2 instead.
         self._add_metric(

@@ -1,0 +1,124 @@
+"""Cross-process disk cache of the coverage train-stats aggregates.
+
+``CoverageWorker`` opens with one full pass over the training set to collect
+the per-neuron mins / maxs / Welford stds that parameterize NBC, SNAC and
+KMNC. HOST_PHASE.json prices that pass at ~28 s/run on the paper workload —
+and ``run_scheduler`` spawns a fresh interpreter per phase, so before this
+cache every scheduler process paid it again for the SAME (params, train set,
+tap layers) triple. The aggregates are tiny (three 1-D float arrays per
+neuron axis), pure functions of that triple, and expensive to recompute:
+the textbook disk-cache shape.
+
+Semantics mirror ``SAFitCache`` (engine/sa_prep.py): one pickle keyed by a
+content fingerprint, atomic writes so concurrent scheduler workers can share
+one dir, meta verified on load, and ANY read/unpickle failure degrading to a
+recompute — a corrupt cache can cost time, never correctness. Unlike the SA
+fingerprint, the key carries NO cluster-backend tag: the aggregates do not
+depend on how downstream estimators are fitted.
+"""
+
+import logging
+import os
+import pickle
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from simple_tip_tpu import obs
+from simple_tip_tpu.utils.artifacts_io import atomic_write_bytes
+
+logger = logging.getLogger(__name__)
+
+#: Bump when the entry layout or the aggregate-statistics definition changes;
+#: stale-version entries are treated as misses.
+COV_STATS_FORMAT_VERSION = "cov-stats-cache-v1"
+
+
+def _as_host(stat):
+    """Aggregates are per-layer lists of arrays (ragged across tap widths);
+    materialize each leaf as host numpy without coercing the list shape."""
+    if isinstance(stat, (list, tuple)):
+        return [np.asarray(a) for a in stat]
+    return np.asarray(stat)
+
+
+class CoverageStatsCache:
+    """Disk cache of one ``(mins, maxs, std)`` aggregate-statistics triple."""
+
+    def __init__(self, root: str, fingerprint: str):
+        self.root = root
+        self.fingerprint = fingerprint
+
+    @classmethod
+    def from_env(
+        cls, params, training_set, activation_layers: Sequence
+    ) -> Optional["CoverageStatsCache"]:
+        """Cache handle per ``TIP_COV_STATS_CACHE_DIR`` policy, or None when
+        off (``off``/``0``; default ``$TIP_ASSETS/coverage_stats_cache``)."""
+        raw = os.environ.get("TIP_COV_STATS_CACHE_DIR", "").strip()
+        if raw.lower() in ("off", "0"):
+            return None
+        if not raw:
+            from simple_tip_tpu.config import output_folder
+
+            raw = os.path.join(output_folder(), "coverage_stats_cache")
+        from simple_tip_tpu.engine.sa_prep import content_fingerprint
+
+        fp = content_fingerprint(
+            COV_STATS_FORMAT_VERSION, params, training_set, activation_layers
+        )
+        return cls(root=raw, fingerprint=fp)
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.root, f"cov_stats_{self.fingerprint[:16]}.pkl")
+
+    def load(self) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """The cached ``(mins, maxs, std)``, or None on miss/stale/corrupt."""
+        path = self.path
+        try:
+            with open(path, "rb") as f:
+                entry = pickle.load(f)
+            meta = entry["meta"]
+            if (
+                meta["version"] != COV_STATS_FORMAT_VERSION
+                or meta["fingerprint"] != self.fingerprint
+            ):
+                logger.info("coverage-stats cache STALE (%s)", path)
+                obs.counter("cov_stats_cache.stale").inc()
+                obs.event("cov_stats_cache", outcome="stale")
+                return None
+            mins, maxs, std = entry["stats"]
+            obs.counter("cov_stats_cache.hit").inc()
+            obs.event("cov_stats_cache", outcome="hit")
+            logger.info("coverage-stats cache HIT (%s)", path)
+            return _as_host(mins), _as_host(maxs), _as_host(std)
+        except FileNotFoundError:
+            obs.counter("cov_stats_cache.miss").inc()
+            obs.event("cov_stats_cache", outcome="miss")
+            return None
+        except Exception as e:  # noqa: BLE001 — any corrupt entry degrades to recompute
+            logger.warning(
+                "coverage-stats cache entry corrupt (%s: %r); recomputing", path, e
+            )
+            obs.counter("cov_stats_cache.corrupt").inc()
+            obs.event("cov_stats_cache", outcome="corrupt")
+            return None
+
+    def store(self, stats: Tuple[np.ndarray, np.ndarray, np.ndarray]) -> None:
+        """Persist the aggregates (atomic; failures warn, never raise)."""
+        mins, maxs, std = stats
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            entry = {
+                "meta": {
+                    "version": COV_STATS_FORMAT_VERSION,
+                    "fingerprint": self.fingerprint,
+                },
+                "stats": (_as_host(mins), _as_host(maxs), _as_host(std)),
+            }
+            atomic_write_bytes(self.path, pickle.dumps(entry, protocol=4))
+            logger.info("coverage-stats cache stored (%s)", self.path)
+            obs.counter("cov_stats_cache.store").inc()
+        except Exception as e:  # noqa: BLE001 — cache is an optimization only
+            logger.warning("coverage-stats cache store failed (%r)", e)

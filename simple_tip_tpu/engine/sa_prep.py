@@ -129,6 +129,45 @@ def pipeline_enabled() -> bool:
     raise ValueError(f"TIP_SA_PIPELINE={raw!r} not recognized (auto, 1, 0)")
 
 
+def variant_fanout_enabled() -> bool:
+    """Whether whole VARIANTS (not just their modals) fan out over the pool
+    (``TIP_SA_FANOUT``; ``auto`` = on exactly when ``pool_size() > 1``)."""
+    raw = os.environ.get("TIP_SA_FANOUT", "auto").strip().lower()
+    if raw in ("", "auto"):
+        return pool_size() > 1
+    if raw in ("1", "on"):
+        return True
+    if raw in ("0", "off"):
+        return False
+    raise ValueError(f"TIP_SA_FANOUT={raw!r} not recognized (auto, 1, 0)")
+
+
+def sa_cache_max_bytes() -> Optional[int]:
+    """Size cap for the sa_fit_cache dir from ``TIP_SA_CACHE_MAX_BYTES``.
+
+    Same grammar as ``TIP_OBS_MAX_BYTES`` (obs/tracer.py): a plain byte
+    count or a ``k``/``m``/``g``-suffixed size; empty / ``0`` / ``off`` /
+    ``unlimited`` / ``none`` means uncapped (None). LSA/MDSA pickles carry
+    d² covariance/precision matrices, so a long-lived shared cache dir
+    grows without bound unless swept.
+    """
+    raw = os.environ.get("TIP_SA_CACHE_MAX_BYTES", "").strip().lower()
+    if not raw:
+        return None
+    if raw in ("0", "off", "unlimited", "none"):
+        return None
+    mult = 1
+    if raw[-1] in ("k", "m", "g"):
+        mult = {"k": 1024, "m": 1024**2, "g": 1024**3}[raw[-1]]
+        raw = raw[:-1]
+    try:
+        return int(float(raw) * mult)
+    except ValueError:
+        raise ValueError(
+            f"TIP_SA_CACHE_MAX_BYTES={raw!r} not recognized (bytes, or k/m/g suffix)"
+        )
+
+
 class FitPool:
     """Bounded spawn-based process pool for independent seeded SA fits.
 
@@ -221,6 +260,77 @@ class SharedTrainPrep:
         return self.flatten_debit
 
 
+def _fit_variant_task(task):
+    """Fit ONE whole registry variant (runs in a pool worker or inline).
+
+    ``task`` = (sa_name, flat train ATs, flat class predictions); returns
+    (sa_name, fitted scorer, fit wall seconds). The worker rebuilds its own
+    ``SharedTrainPrep`` from the shipped flat arrays (flatten is idempotent
+    on an already-flat single layer) and runs a serial fit — every fit is
+    seeded, so the result is bit-identical to the in-process path.
+    Top-level so spawn can pickle it.
+    """
+    import time
+
+    sa_name, flat, pred = task
+    t0 = time.perf_counter()
+    prep = SharedTrainPrep([flat], pred)
+    scorer = VariantFitter(prep, FitPool(1)).build(sa_name)
+    return sa_name, scorer, time.perf_counter() - t0
+
+
+def _poolable_variant(sa_name: str) -> bool:
+    """Whether a whole-variant fit may run in a spawn worker.
+
+    dsa / pc-lsa / pc-mdsa are pure host numpy/scipy fits; pc-mlsa and
+    pc-mmdsa involve GMM/KMeans fits that run on the device when the
+    resolved cluster backend is jax — pooling those would silently change
+    numerics vs the in-process device path, so they stay in the parent.
+    """
+    if sa_name in ("dsa", "pc-lsa", "pc-mdsa"):
+        return True
+    return resolved_cluster_backend() == "sklearn"
+
+
+def estimate_variant_fit_bytes(sa_name: str, n: int, d: int) -> int:
+    """Worst-case worker working-set estimate for one variant fit.
+
+    Every worker ships the f32 (n, d) train matrix and rebuilds the
+    by-class partition (~2 more transient copies). On top of that: LSA's
+    KDE whitens an f64 copy (dims capped ~300 by the variance filter),
+    MDSA/MMDSA factor d² f64 covariance/precision matrices, MLSA holds
+    per-component responsibilities (~3 more n·d f32 blocks at 3
+    components). The profile only needs to be the right order of
+    magnitude: it sizes the fan-out, it does not gate correctness.
+    """
+    base = 3 * n * d * 4
+    if sa_name in ("pc-lsa",):
+        return base + n * min(d, 300) * 8 + 3 * 300 * 300 * 8
+    if sa_name in ("pc-mdsa", "pc-mmdsa"):
+        return base + 3 * d * d * 8
+    if sa_name == "pc-mlsa":
+        return base + 4 * n * d * 4
+    return base + n * d * 4  # dsa keeps a reference copy for kNN
+
+
+def fanout_workers(names: Sequence[str], n: int, d: int) -> int:
+    """How many whole-variant fits may run at once within the memory budget
+    (half of available RAM; serial when psutil or the budget says no)."""
+    cap = min(pool_size(), len(names))
+    if cap <= 1:
+        return 1
+    try:
+        import psutil
+
+        budget = psutil.virtual_memory().available // 2
+    except Exception:  # noqa: BLE001 — no psutil: trust pool_size alone
+        return cap
+    per_variant = max(
+        [estimate_variant_fit_bytes(s, n, d) for s in names] or [1]
+    )
+    return max(1, min(cap, budget // max(1, per_variant)))
+
+
 class VariantFitter:
     """Builds every registry variant from one ``SharedTrainPrep``.
 
@@ -282,22 +392,59 @@ class VariantFitter:
             return MultiModalSA(discriminator=discriminator, modal_sa=modal_sa)
         raise KeyError(f"unknown SA variant {sa_name!r}")
 
+    def build_variants(self, names: Sequence[str]) -> Dict[str, Tuple[object, float]]:
+        """Fit several variants, whole-variant fan-out over the pool.
 
-def train_fingerprint(params, training_dataset, sa_layers: Sequence) -> str:
-    """Content fingerprint of one (model, train set, tap config) triple.
+        Poolable variants (``_poolable_variant``) ship as one task each to
+        a memory-profiled worker count (``fanout_workers``); the rest fit
+        serially in-process. Returns ``{sa_name: (scorer, fit_s)}`` where
+        ``fit_s`` is the fit's own wall time (the worker's wall includes
+        its prep rebuild — the parent's shared-prep debit is accounted
+        separately by the caller, never double-counted here).
+        """
+        import time
 
-    sha256 over the parameter leaves, the raw training array bytes, the SA
-    tap layers, the resolved cluster backend (it changes fitted estimators)
-    and the cache format version. Deliberately does NOT require a forward
-    pass: a fully-warm cache must be able to skip train-AT collection
-    entirely.
+        n, d = self.prep.flat.shape
+        pooled = [s for s in names if _poolable_variant(s)]
+        out: Dict[str, Tuple[object, float]] = {}
+        workers = fanout_workers(pooled, n, d) if pooled else 1
+        if workers > 1 and len(pooled) > 1:
+            tasks = [(s, self.prep.flat, self.prep.pred) for s in pooled]
+            fan_pool = FitPool(workers)
+            try:
+                for sa_name, scorer, fit_s in fan_pool.map(_fit_variant_task, tasks):
+                    out[sa_name] = (scorer, fit_s)
+            finally:
+                fan_pool.close()
+        else:
+            pooled = []
+        for sa_name in names:
+            if sa_name in out:
+                continue
+            t0 = time.perf_counter()
+            scorer = self.build(sa_name)
+            out[sa_name] = (scorer, time.perf_counter() - t0)
+        return out
+
+
+def content_fingerprint(
+    version: str, params, training_dataset, layers: Sequence, *tags: str
+) -> str:
+    """sha256 of one (model, train set, tap config) triple plus cache tags.
+
+    Hash order is the stable contract every disk cache keys on: version
+    string, ``repr(list(layers))``, each extra tag, then parameter leaves
+    (shape/dtype/bytes) and the raw training array. Deliberately does NOT
+    require a forward pass: a fully-warm cache must be able to skip
+    train-AT collection entirely.
     """
     import jax
 
     h = hashlib.sha256()
-    h.update(CACHE_FORMAT_VERSION.encode())
-    h.update(repr(list(sa_layers)).encode())
-    h.update(resolved_cluster_backend().encode())
+    h.update(version.encode())
+    h.update(repr(list(layers)).encode())
+    for tag in tags:
+        h.update(tag.encode())
     for leaf in jax.tree_util.tree_leaves(params):
         arr = np.asarray(leaf)
         h.update(str(arr.shape).encode() + str(arr.dtype).encode())
@@ -306,6 +453,19 @@ def train_fingerprint(params, training_dataset, sa_layers: Sequence) -> str:
     h.update(str(data.shape).encode() + str(data.dtype).encode())
     h.update(np.ascontiguousarray(data).tobytes())
     return h.hexdigest()
+
+
+def train_fingerprint(params, training_dataset, sa_layers: Sequence) -> str:
+    """SA-fit fingerprint: ``content_fingerprint`` tagged with the resolved
+    cluster backend (it changes fitted estimators, so sklearn- and
+    jax-resolved fits may never cross-hit)."""
+    return content_fingerprint(
+        CACHE_FORMAT_VERSION,
+        params,
+        training_dataset,
+        sa_layers,
+        resolved_cluster_backend(),
+    )
 
 
 class SAFitCache:
@@ -399,6 +559,10 @@ class SAFitCache:
                 return None
             obs.counter("sa_fit_cache.hit").inc()
             obs.event("sa_cache", variant=sa_name, outcome="hit")
+            try:
+                os.utime(path)  # LRU recency: a hit entry is the last swept
+            except OSError:
+                pass
             return entry["scorer"]
         except FileNotFoundError:
             obs.counter("sa_fit_cache.miss").inc()
@@ -439,5 +603,39 @@ class SAFitCache:
             atomic_write_bytes(path, pickle.dumps(entry, protocol=4))
             logger.info("sa-fit cache stored %s (%s)", sa_name, path)
             obs.counter("sa_fit_cache.store").inc()
+            self._sweep(keep=path)
         except Exception as e:  # noqa: BLE001 — cache is an optimization only
             logger.warning("sa-fit cache store failed for %s (%r)", sa_name, e)
+
+    def _sweep(self, keep: str) -> None:
+        """Evict least-recently-used entries until the dir fits the
+        ``TIP_SA_CACHE_MAX_BYTES`` cap (never the just-written ``keep``
+        entry; concurrent-unlink races are benign misses)."""
+        cap = sa_cache_max_bytes()
+        if cap is None:
+            return
+        entries = []
+        for name in os.listdir(self.root):
+            if not name.endswith(".pkl"):
+                continue
+            full = os.path.join(self.root, name)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, st.st_size, full))
+        total = sum(size for _, size, _ in entries)
+        keep = os.path.abspath(keep)
+        for _, size, full in sorted(entries):
+            if total <= cap:
+                break
+            if os.path.abspath(full) == keep:
+                continue
+            try:
+                os.unlink(full)
+            except OSError:
+                continue
+            total -= size
+            logger.info("sa-fit cache evicted %s (cap %d bytes)", full, cap)
+            obs.counter("sa_fit_cache.evict").inc()
+            obs.event("sa_cache", outcome="evict", path=full)

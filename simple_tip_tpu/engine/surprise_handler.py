@@ -53,6 +53,7 @@ from simple_tip_tpu.engine.sa_prep import (
     VariantFitter,
     pipeline_enabled,
     pool_size,
+    variant_fanout_enabled,
 )
 from simple_tip_tpu.ops.prioritizers import cam
 from simple_tip_tpu.ops.surprise import (
@@ -218,17 +219,76 @@ class SurpriseHandler:
                 scorer.badge_size = dsa_badge_size
             return sa_name, scorer, setup_s
 
+    def _prepared_fanout(
+        self, dsa_badge_size: Optional[int]
+    ) -> Iterator[PreparedScorer]:
+        """All variants at once: load what the cache has, fan the missing
+        WHOLE-variant fits over the process pool, yield in registry order.
+
+        Setup accounting matches ``_prepare_one``: hits record their load
+        time; fits record train-AT collection + shared-prep debit + the
+        fit's own wall (a pooled worker's wall includes its in-worker prep
+        rebuild — the parent's debit is charged exactly once per variant,
+        never double-counted by the worker).
+        """
+        names = list(SA_VARIANTS)
+        cache = self._ensure_cache()
+        prepared: Dict[str, PreparedScorer] = {}
+        missing: List[str] = []
+        for name in names:
+            scorer = None
+            load_timer = Timer()
+            if cache is not None:
+                with load_timer:
+                    scorer = cache.load(name)
+            if scorer is not None:
+                logger.info(
+                    "sa-fit cache HIT for %s (%s)", name, cache.describe(name)
+                )
+                with obs.span("sa_fit", variant=name, fanout=True) as span:
+                    span.set(cached=True, setup_s=load_timer.get())
+                prepared[name] = (name, scorer, load_timer.get())
+            else:
+                missing.append(name)
+        if missing:
+            fitter = self._ensure_fitter()
+            logger.info("fan-out fitting %s", ", ".join(missing))
+            built = fitter.build_variants(missing)
+            for name in missing:
+                scorer, fit_s = built[name]
+                setup_s = (
+                    self.train_at_timer.get()
+                    + self._prep.debit_for(name)
+                    + fit_s
+                )
+                with obs.span("sa_fit", variant=name, fanout=True) as span:
+                    span.set(cached=False, setup_s=setup_s)
+                if cache is not None:
+                    cache.store(name, scorer)
+                prepared[name] = (name, scorer, setup_s)
+        for name in names:
+            sa_name, scorer, setup_s = prepared[name]
+            if dsa_badge_size is not None and isinstance(scorer, DSA):
+                scorer.badge_size = dsa_badge_size
+            yield sa_name, scorer, setup_s
+
     def _prepared_scorers(
         self, dsa_badge_size: Optional[int]
     ) -> Iterator[PreparedScorer]:
         """Yield fitted scorers in registry order, optionally pipelined.
 
-        With the pipeline on, variant *i+1* fits (or cache-loads) in a
+        With whole-variant fan-out on (``TIP_SA_FANOUT``; auto = when the
+        fit pool has more than one worker), all five fits dispatch to the
+        pool at once instead of riding the two-stage pipeline. Otherwise,
+        with the pipeline on, variant *i+1* fits (or cache-loads) in a
         single background thread while the caller scores variant *i* —
         a bounded two-stage pipeline; the fits themselves stay in
         registry order, so timing records and results are unaffected.
         """
         names = list(SA_VARIANTS)
+        if variant_fanout_enabled() and len(names) >= 2:
+            yield from self._prepared_fanout(dsa_badge_size)
+            return
         if not pipeline_enabled() or len(names) < 2:
             for name in names:
                 yield self._prepare_one(name, dsa_badge_size)

@@ -54,6 +54,7 @@ HEALTH_COUNTERS = (
     "watchdog.probe_fail",
     "watchdog.probe_timeout",
     "sa_fit_cache.corrupt",
+    "cov_stats_cache.corrupt",
     "breaker.opened",
     "breaker.short_circuit",
     "breaker.degraded",
@@ -119,13 +120,45 @@ def _normalize_bench(doc: dict, source: str) -> dict:
     return snap
 
 
+def _normalize_host_phase(doc: dict, source: str) -> dict:
+    """A ``HOST_PHASE.json`` capture (scripts/measure_host_phase.py) as a
+    snapshot: the headline host-phase durations become phases so `obs
+    trend` can gate the test-prio trajectory the same way it gates bench
+    fixtures."""
+    snap = _blank_snapshot("host_phase", source)
+    for key, phase in (
+        ("test_prio_s", "test_prio"),
+        ("train_1epoch_s", "train_1epoch"),
+    ):
+        if isinstance(doc.get(key), (int, float)):
+            snap["phases"][phase] = float(doc[key])
+    for label, stage in (doc.get("sa_setup") or {}).items():
+        if isinstance(stage, dict) and isinstance(
+            stage.get("setup_total_s"), (int, float)
+        ):
+            snap["phases"][f"sa_setup.{label}"] = float(stage["setup_total_s"])
+    for label, stage in (doc.get("cov_stats") or {}).items():
+        if isinstance(stage, dict) and isinstance(
+            stage.get("debit_s"), (int, float)
+        ):
+            snap["phases"][f"cov_stats.{label}"] = float(stage["debit_s"])
+    if "degraded" in doc:
+        snap["degraded"] = bool(doc.get("degraded"))
+    counters = (doc.get("obs_metrics") or {}).get("counters") or {}
+    snap["counters"] = {
+        k: v for k, v in counters.items() if isinstance(v, (int, float))
+    }
+    return snap
+
+
 def load_snapshot(target) -> dict:
     """Normalize ``target`` into ``{kind, phases, counters, degraded, value}``.
 
     ``target`` is a path: an obs run dir / ``.jsonl`` file (trace mode), or
-    a JSON document (bench record, ``BENCH_r0*.json`` wrapper, or
-    ``summary --json`` output). Raises ``ValueError`` on unrecognizable
-    input — regress must fail loudly, not compare garbage.
+    a JSON document (bench record, ``BENCH_r0*.json`` wrapper,
+    ``HOST_PHASE.json`` capture, or ``summary --json`` output). Raises
+    ``ValueError`` on unrecognizable input — regress must fail loudly, not
+    compare garbage.
     """
     snap = _blank_snapshot("trace", str(target))
     if os.path.isdir(target) or str(target).endswith(".jsonl"):
@@ -157,6 +190,9 @@ def load_snapshot(target) -> dict:
 
     if "metric" in doc and "value" in doc:  # bench record
         return _normalize_bench(doc, str(target))
+
+    if "test_prio_s" in doc or "sa_setup" in doc:  # HOST_PHASE.json capture
+        return _normalize_host_phase(doc, str(target))
 
     if isinstance(doc.get("spans"), dict):  # summary --json document
         snap["phases"] = {

@@ -100,6 +100,11 @@ def _classify_file(path: str):
             doc = doc["parsed"]
         if "metric" in doc and "value" in doc:
             return "bench"
+        # Renamed HOST_PHASE captures (trend fixtures, archived
+        # trajectories) classify by the same keys regress.load_snapshot
+        # dispatches on.
+        if "test_prio_s" in doc or "sa_setup" in doc:
+            return "host_phase"
     return None
 
 
@@ -237,6 +242,13 @@ def _rows_from_obs_run(path: str, seq: int) -> list:
             row["case_study"] = attrs.get("case_study")
             rows.append(stamp(row, rec.get("ts")))
         else:
+            # Prio-scoring spans carry a variant attr: split them into
+            # per-variant features (sa_score.pc-mlsa, ...) so `obs predict`
+            # learns the post-device-pipeline test_prio cost per variant
+            # instead of one blended aggregate. Everything else aggregates
+            # by bare span name as before.
+            if name in ("sa_fit", "sa_score", "sa_cam") and attrs.get("variant"):
+                name = f"{name}.{attrs['variant']}"
             cnt, tot = agg.get(name, (0, 0.0))
             agg[name] = (cnt + 1, tot + dur)
     for name, (cnt, tot) in sorted(agg.items()):

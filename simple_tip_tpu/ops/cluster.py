@@ -30,6 +30,56 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _use_device_scoring() -> bool:
+    """Whether fitted-estimator *scoring* (predict / score_samples) should
+    run as jitted device dispatches rather than host numpy. Same backend
+    switch as the fits (``resolved_cluster_backend``), imported at call
+    time: ops/surprise must stay importable without jax."""
+    from simple_tip_tpu.ops.surprise import resolved_cluster_backend
+
+    return resolved_cluster_backend() == "jax"
+
+
+@jax.jit
+def _nearest_centroid(x, c):
+    """Nearest-centroid labels on device (argmin of the expanded quadform)."""
+    d2 = (
+        jnp.sum(x * x, axis=1)[:, None]
+        + jnp.sum(c * c, axis=1)[None, :]
+        - 2.0 * (x @ c.T)
+    )
+    return jnp.argmin(d2, axis=1)
+
+
+def _gmm_weighted_log_prob_impl(x, weights, means, cov):
+    """Per-component weighted log-densities [n, k]; the scoring twin of
+    ``_gmm_em``'s in-loop ``log_prob`` (same jitter as the host path's
+    ``cov + eye*1e-12``; the weight floor is 1e-35 because the host's
+    1e-300 underflows f32 to 0 and would turn the log into -inf)."""
+    d = means.shape[1]
+    chol = jnp.linalg.cholesky(cov + jnp.eye(d) * 1e-12)  # [k, d, d]
+    diff = x[None, :, :] - means[:, None, :]  # [k, n, d]
+    sol = jax.lax.linalg.triangular_solve(
+        chol, jnp.swapaxes(diff, 1, 2), left_side=True, lower=True
+    )  # [k, d, n]
+    maha = jnp.sum(sol * sol, axis=1)  # [k, n]
+    log_det = 2.0 * jnp.sum(jnp.log(jnp.diagonal(chol, axis1=1, axis2=2)), axis=1)
+    log_gauss = -0.5 * (maha + d * jnp.log(2 * jnp.pi) + log_det[:, None])
+    return log_gauss.T + jnp.log(jnp.maximum(weights, 1e-35))[None, :]
+
+
+@jax.jit
+def _gmm_score_samples_device(x, weights, means, cov):
+    return jax.scipy.special.logsumexp(
+        _gmm_weighted_log_prob_impl(x, weights, means, cov), axis=1
+    )
+
+
+@jax.jit
+def _gmm_predict_device(x, weights, means, cov):
+    return jnp.argmax(_gmm_weighted_log_prob_impl(x, weights, means, cov), axis=1)
+
+
 def _kmeans_plus_plus(rng: np.random.RandomState, x: np.ndarray, k: int) -> np.ndarray:
     """Seeded k-means++ initial centroids (host, cheap)."""
     n = x.shape[0]
@@ -108,10 +158,14 @@ class KMeans:
         return self
 
     def predict(self, x: np.ndarray) -> np.ndarray:
-        """Nearest-centroid labels."""
+        """Nearest-centroid labels (one device dispatch + one transfer when
+        the resolved backend is jax; host numpy is the reference path)."""
         assert self.cluster_centers_ is not None, "KMeans is not fitted"
         x = np.asarray(x, dtype=np.float32)
         c = self.cluster_centers_
+        if _use_device_scoring():
+            labels = _nearest_centroid(jnp.asarray(x), jnp.asarray(c))
+            return np.asarray(labels)
         d2 = (
             (x * x).sum(1)[:, None]
             + (c * c).sum(1)[None, :]
@@ -332,12 +386,28 @@ class GaussianMixture:
             )
         return out
 
+    def _device_params(self, x: np.ndarray):
+        return (
+            jnp.asarray(np.asarray(x, dtype=np.float32)),
+            jnp.asarray(self.weights_),
+            jnp.asarray(self.means_),
+            jnp.asarray(self.covariances_),
+        )
+
     def score_samples(self, x: np.ndarray) -> np.ndarray:
-        """Log-likelihood of each sample under the mixture."""
+        """Log-likelihood of each sample under the mixture (one jitted
+        dispatch + one transfer on the jax backend; float64 host scipy is
+        the reference path, parity pinned by tests/test_device_scoring.py)."""
+        if _use_device_scoring():
+            scores = _gmm_score_samples_device(*self._device_params(x))
+            return np.asarray(scores, dtype=np.float64)  # tiplint: disable=f64-on-tpu (terminal host transfer; dtype parity with the scipy path)
         from scipy.special import logsumexp
 
         return logsumexp(self._weighted_log_prob(x), axis=1)
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         """Most likely component per sample."""
+        if _use_device_scoring():
+            labels = _gmm_predict_device(*self._device_params(x))
+            return np.asarray(labels)
         return np.argmax(self._weighted_log_prob(x), axis=1)

@@ -13,11 +13,19 @@ reference's stabilization semantics (reference: src/core/stable_kde.py:26-101):
   offending leading minor so LSA can drop that feature and retry
   (reference: src/core/surprise.py:454-473).
 
-float64 throughout: TPUs have no native f64, and KDE fitting is a tiny
-(d<=300) host-side computation; only the *evaluation* over many test points is
-bulk work, implemented as a blocked float64 numpy quadform (still host — parity
-with scipy's float64 results matters more than device speed here, and APFD
-depends on score ordering which f32 exp underflow would distort).
+float64 throughout on the host path: TPUs have no native f64, and KDE fitting
+is a tiny (d<=300) host-side computation. The *evaluation* over many test
+points is the bulk work; when the resolved cluster backend is ``jax`` it runs
+as ONE jitted log-space dispatch over device-resident points (whiten, pairwise
+quadform, logsumexp — the log-space form keeps f32 inside the dynamic range
+that the normalization constant ``exp(-log_det/2)/n`` would overflow), with a
+single final device→host transfer. The blocked float64 numpy quadform stays
+the CPU/reference path — parity between the two is pinned by seeded tests
+(tests/test_device_scoring.py); APFD depends on score ordering, which the
+log-space device form preserves.
+
+Module import stays jax-free on purpose: spawned SA fit-pool workers import
+this module and must never pay (or wedge on) an accelerator-backend init.
 """
 
 import warnings
@@ -25,6 +33,45 @@ from typing import Optional
 
 import numpy as np
 import scipy.linalg
+
+_DEVICE_EVAL = None
+
+
+def _use_device_backend() -> bool:
+    """Whether KDE evaluation should run on the device (resolved cluster
+    backend is ``jax``). Imported at call time: ops/surprise imports this
+    module at its top level."""
+    from simple_tip_tpu.ops.surprise import resolved_cluster_backend
+
+    return resolved_cluster_backend() == "jax"
+
+
+def _device_eval_fn():
+    """Cached jitted log-space KDE evaluation kernel (lazy: module import
+    must stay jax-free for the spawned fit-pool workers)."""
+    global _DEVICE_EVAL
+    if _DEVICE_EVAL is None:
+        import jax
+        import jax.numpy as jnp
+
+        def _eval(chol, dataset, points, log_norm):
+            white_data = jax.scipy.linalg.solve_triangular(chol, dataset, lower=True)
+            white_points = jax.scipy.linalg.solve_triangular(chol, points, lower=True)
+            # squared whitened distances: |x|^2 + |y|^2 - 2 x.y
+            d2 = (
+                jnp.sum(white_data**2, axis=0)[None, :]
+                + jnp.sum(white_points**2, axis=0)[:, None]
+                - 2.0 * (white_points.T @ white_data)
+            )
+            d2 = jnp.maximum(d2, 0.0)
+            # log-space: exp(-log_det/2)/n over/underflows f32 where the f64
+            # host path does not; logsumexp keeps the full dynamic range.
+            return jnp.exp(
+                jax.scipy.special.logsumexp(-0.5 * d2, axis=1) + log_norm
+            )
+
+        _DEVICE_EVAL = jax.jit(_eval)
+    return _DEVICE_EVAL
 
 
 class KDESingularError(np.linalg.LinAlgError):
@@ -123,6 +170,15 @@ class StableGaussianKDE:
             )
         # Whiten with the cholesky of cov (not 2*pi*cov): solve L w = x.
         chol = self.cho_cov / np.sqrt(2 * np.pi)
+        if _use_device_backend():
+            log_norm = np.float32(-0.5 * self.log_det - np.log(self.n))
+            densities = _device_eval_fn()(
+                chol.astype(np.float32),
+                self.dataset.astype(np.float32),
+                points.astype(np.float32),
+                log_norm,
+            )
+            return np.asarray(densities, dtype=np.float64)
         white_data = scipy.linalg.solve_triangular(chol, self.dataset, lower=True)
         white_points = scipy.linalg.solve_triangular(chol, points, lower=True)
         m = points.shape[1]

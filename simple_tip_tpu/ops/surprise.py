@@ -433,6 +433,25 @@ class MultiModalSA(SA):
 # ---------------------------------------------------------------------------
 
 
+_MDSA_DEVICE_SCORE = None
+
+
+def _mdsa_device_score_fn():
+    """Cached jitted MDSA quadform (lazy: module import stays jax-free for
+    the spawned SA fit-pool workers)."""
+    global _MDSA_DEVICE_SCORE
+    if _MDSA_DEVICE_SCORE is None:
+        import jax
+        import jax.numpy as jnp
+
+        def _score(activations, location, precision):
+            centered = activations - location
+            return jnp.sum((centered @ precision) * centered, axis=1)
+
+        _MDSA_DEVICE_SCORE = jax.jit(_score)
+    return _MDSA_DEVICE_SCORE
+
+
 class MDSA(SA):
     """Mahalanobis-distance surprise adequacy (squared Mahalanobis distance to
     the training distribution; reference: src/core/surprise.py:374-393)."""
@@ -468,6 +487,14 @@ class MDSA(SA):
         num_threads: int = None,
     ) -> np.ndarray:
         activations = _flatten_layers(activations).astype(np.float32)
+        if resolved_cluster_backend() == "jax":
+            # one jitted dispatch over device-resident ATs + one transfer;
+            # host f64-reduction einsum below stays the reference path
+            # (parity pinned by tests/test_device_scoring.py).
+            scores = _mdsa_device_score_fn()(
+                activations, self.location, self.precision
+            )
+            return np.asarray(scores, dtype=np.float64)  # tiplint: disable=f64-on-tpu (terminal host transfer; dtype parity with the host einsum path)
         centered = activations - self.location
         # one BLAS gemm + a row-wise dot; the 3-operand einsum form takes
         # numpy's unoptimized path and was ~5x slower. f64 row reduction

@@ -1,0 +1,224 @@
+"""Device-vs-host parity of the prio-scoring kernels plus the
+CoverageStatsCache reuse contract.
+
+The jitted jnp scoring paths (``TIP_CLUSTER_BACKEND=jax``) are PURE
+optimizations over the host NumPy/scipy reference paths: the same seeded
+inputs must produce the same densities / log-likelihoods / labels within
+the pinned f32-vs-f64 tolerances (exact for argmax labels on separated
+blobs). Forcing ``jax`` never consults the platform, so these tests
+exercise the device code path under the CPU jax of the test environment.
+
+The coverage-stats cache is the train-stats analogue of SAFitCache: a
+second CoverageWorker over the same (params, train set, tap layers) must
+hit the disk cache and skip the train walk entirely; corrupt entries must
+fall back to the recompute path.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from simple_tip_tpu.ops.cluster import GaussianMixture, KMeans
+from simple_tip_tpu.ops.kde import StableGaussianKDE
+from simple_tip_tpu.ops.surprise import MDSA
+
+
+def _blobs(rng, centers, n_per=80, d=8, spread=0.12):
+    xs = []
+    for c in centers:
+        xs.append(rng.normal(c, spread, size=(n_per, d)))
+    return np.concatenate(xs).astype(np.float32)
+
+
+# --- device scoring parity ---------------------------------------------------
+
+
+def test_kde_evaluate_device_matches_host(monkeypatch):
+    """StableGaussianKDE.evaluate: one jitted logsumexp dispatch on the jax
+    backend matches the blocked host f64 path within f32 tolerance."""
+    rng = np.random.RandomState(0)
+    dataset = rng.normal(size=(4, 200))
+    points = np.concatenate(
+        [rng.normal(size=(4, 48)), rng.normal(3.0, 1.0, size=(4, 16))], axis=1
+    )
+    kde = StableGaussianKDE(dataset)
+    assert not kde.prepare_failed
+
+    monkeypatch.setenv("TIP_CLUSTER_BACKEND", "sklearn")
+    host = kde.evaluate(points)
+    monkeypatch.setenv("TIP_CLUSTER_BACKEND", "jax")
+    device = kde.evaluate(points)
+
+    from simple_tip_tpu.ops import kde as kde_mod
+
+    assert kde_mod._DEVICE_EVAL is not None, "jax backend must take the jitted path"
+    assert device.dtype == np.float64 and device.shape == host.shape
+    assert np.all(host > 0)
+    np.testing.assert_allclose(device, host, rtol=5e-3, atol=1e-9)
+
+
+def test_gmm_score_samples_and_predict_device_match_host(monkeypatch):
+    """GaussianMixture.score_samples within f32 tolerance; predict labels
+    exactly equal on well-separated blobs."""
+    rng = np.random.RandomState(2)
+    x = _blobs(rng, [0.0, 1.0, 2.0])
+    gmm = GaussianMixture(n_components=3, random_state=0).fit(x)
+    query = np.concatenate([x[::7], x[::7] + 0.4]).astype(np.float32)
+
+    monkeypatch.setenv("TIP_CLUSTER_BACKEND", "sklearn")
+    host_ll = gmm.score_samples(query)
+    host_labels = gmm.predict(query)
+    monkeypatch.setenv("TIP_CLUSTER_BACKEND", "jax")
+    device_ll = gmm.score_samples(query)
+    device_labels = gmm.predict(query)
+
+    assert device_ll.dtype == np.float64
+    np.testing.assert_allclose(device_ll, host_ll, rtol=2e-3, atol=1e-5)
+    np.testing.assert_array_equal(device_labels, host_labels)
+
+
+def test_kmeans_predict_device_matches_host(monkeypatch):
+    """KMeans.predict: the jitted nearest-centroid argmin agrees exactly
+    with the host path on separated blobs."""
+    rng = np.random.RandomState(3)
+    x = _blobs(rng, [0.0, 1.5, 3.0])
+    km = KMeans(n_clusters=3, random_state=0).fit(x)
+    query = np.concatenate([x[1::5], x[1::5] + 0.3]).astype(np.float32)
+
+    monkeypatch.setenv("TIP_CLUSTER_BACKEND", "sklearn")
+    host = km.predict(query)
+    monkeypatch.setenv("TIP_CLUSTER_BACKEND", "jax")
+    device = km.predict(query)
+
+    np.testing.assert_array_equal(device, host)
+
+
+def test_mdsa_device_matches_host(monkeypatch):
+    """MDSA scoring: the jitted quadform over device-resident ATs matches
+    the host f64-reduction einsum within the pinned tolerance."""
+    rng = np.random.RandomState(4)
+    train = rng.normal(size=(240, 16)).astype(np.float32)
+    test = rng.normal(0.3, 1.1, size=(50, 16)).astype(np.float32)
+    mdsa = MDSA([train])
+
+    monkeypatch.setenv("TIP_CLUSTER_BACKEND", "sklearn")
+    host = mdsa([test], None)
+    monkeypatch.setenv("TIP_CLUSTER_BACKEND", "jax")
+    device = mdsa([test], None)
+
+    assert device.dtype == np.float64 and device.shape == host.shape
+    assert np.all(host >= 0)
+    np.testing.assert_allclose(device, host, rtol=2e-3, atol=1e-4)
+
+
+# --- coverage train-stats cache ----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_cov_model():
+    """A minimal tap-contract model + params + train set for CoverageWorker."""
+    import jax
+    from flax import linen as nn
+
+    from simple_tip_tpu.models.train import init_params
+
+    class TinyTapNet(nn.Module):
+        """Two dense taps; tanh keeps every unit live (no dead relus)."""
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            taps = {}
+            h = nn.tanh(nn.Dense(8)(x))
+            taps[0] = h
+            probs = nn.softmax(nn.Dense(3)(h))
+            taps[1] = probs
+            return probs, taps
+
+    model = TinyTapNet()
+    rng = np.random.RandomState(7)
+    x_train = rng.normal(size=(48, 6)).astype(np.float32)
+    params = init_params(model, jax.random.PRNGKey(0), x_train[:1])
+    return model, params, x_train
+
+
+def _make_worker(tiny_cov_model):
+    from simple_tip_tpu.engine.coverage_handler import CoverageWorker
+    from simple_tip_tpu.engine.model_handler import BaseModel
+
+    model, params, x_train = tiny_cov_model
+    bm = BaseModel(model, params, activation_layers=[0, 1], batch_size=48)
+    return CoverageWorker(bm, training_set=x_train, spill="memory")
+
+
+def test_coverage_stats_cache_cross_instance_reuse(
+    tiny_cov_model, tmp_path, monkeypatch
+):
+    """The train-stats pass is paid once per cache dir: a second worker
+    (a stand-in for the next scheduler process) hits the disk cache and
+    builds byte-identical NBC thresholds from it."""
+    cache_dir = tmp_path / "cov_stats_cache"
+    monkeypatch.setenv("TIP_COV_STATS_CACHE_DIR", str(cache_dir))
+
+    cold = _make_worker(tiny_cov_model)
+    assert cold.stats_cache_outcome == "miss"
+    entries = sorted(os.listdir(cache_dir))
+    assert len(entries) == 1 and entries[0].startswith("cov_stats_")
+
+    warm = _make_worker(tiny_cov_model)
+    assert warm.stats_cache_outcome == "hit"
+    # same cached aggregates -> identical metric construction on both sides
+    cold_nbc = cold.metrics["NBC_0.5"]
+    warm_nbc = warm.metrics["NBC_0.5"]
+    np.testing.assert_array_equal(
+        np.asarray(cold_nbc.min_boundaries), np.asarray(warm_nbc.min_boundaries)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cold_nbc.max_boundaries), np.asarray(warm_nbc.max_boundaries)
+    )
+    # the hit debit is the LOAD time, not the ~train-walk recompute
+    assert warm.setup_times["NBC_0"] <= cold.setup_times["NBC_0"]
+
+
+def test_coverage_stats_cache_corrupt_entry_recomputes(
+    tiny_cov_model, tmp_path, monkeypatch
+):
+    """A truncated/garbage cache entry is a miss, never an exception."""
+    cache_dir = tmp_path / "cov_stats_cache"
+    monkeypatch.setenv("TIP_COV_STATS_CACHE_DIR", str(cache_dir))
+
+    _make_worker(tiny_cov_model)
+    (entry,) = os.listdir(cache_dir)
+    with open(cache_dir / entry, "wb") as f:
+        f.write(b"not a pickle")
+
+    worker = _make_worker(tiny_cov_model)
+    assert worker.stats_cache_outcome == "miss"
+
+
+def test_coverage_stats_cache_stale_fingerprint_misses(
+    tiny_cov_model, tmp_path, monkeypatch
+):
+    """An entry whose recorded fingerprint disagrees with the filename's
+    (e.g. a format-version bump) must be treated as stale, not served."""
+    from simple_tip_tpu.engine.coverage_stats_cache import CoverageStatsCache
+
+    cache_dir = tmp_path / "cov_stats_cache"
+    monkeypatch.setenv("TIP_COV_STATS_CACHE_DIR", str(cache_dir))
+    model, params, x_train = tiny_cov_model
+    cache = CoverageStatsCache.from_env(params, x_train, [0, 1])
+    cache.store((np.zeros(3), np.ones(3), np.ones(3)))
+    with open(cache.path, "rb") as f:
+        entry = pickle.load(f)
+    entry["meta"]["fingerprint"] = "deadbeef"
+    with open(cache.path, "wb") as f:
+        pickle.dump(entry, f)
+    assert cache.load() is None
+
+
+def test_coverage_stats_cache_off_knob(tiny_cov_model, tmp_path, monkeypatch):
+    """TIP_COV_STATS_CACHE_DIR=off disables persistence entirely."""
+    monkeypatch.setenv("TIP_COV_STATS_CACHE_DIR", "off")
+    worker = _make_worker(tiny_cov_model)
+    assert worker.stats_cache_outcome == "off"

@@ -1069,3 +1069,98 @@ def test_quick_phase_estimate_predicts_from_index(tmp_path):
     assert est["basis"] == "model"
     assert est["predicted_s"] == pytest.approx(10 * 10.2 / 2, rel=0.1)
     assert store.load_rows(idx)  # the hand-written rows are schema-valid
+
+
+# --- device-resident prio pipeline (host-phase gate + per-variant rows) ------
+
+HOST_PHASE_FIXTURE = os.path.join(
+    REPO_ROOT, "tests", "fixtures", "host_phase_trend"
+)
+
+
+def _host_phase_targets(*names):
+    return [os.path.join(HOST_PHASE_FIXTURE, n) for n in names]
+
+
+HP_STABLE = (
+    "hp01_stable.json",
+    "hp02_stable.json",
+    "hp03_stable.json",
+    "hp04_stable.json",
+)
+
+
+def test_host_phase_capture_loads_as_snapshot():
+    """HOST_PHASE.json (scripts/measure_host_phase.py) normalizes into a
+    trend snapshot: headline durations and the sa_setup/cov_stats stage
+    labels become phases, the health counters ride along."""
+    from simple_tip_tpu.obs.regress import load_snapshot
+
+    snap = load_snapshot(os.path.join(HOST_PHASE_FIXTURE, "hp01_stable.json"))
+    assert snap["kind"] == "host_phase"
+    assert snap["degraded"] is False
+    assert snap["phases"]["test_prio"] == pytest.approx(60.2)
+    assert snap["phases"]["train_1epoch"] == pytest.approx(311.8)
+    assert snap["phases"]["sa_setup.cold"] == pytest.approx(27.9)
+    assert snap["phases"]["sa_setup.warm"] == pytest.approx(1.4)
+    assert snap["phases"]["cov_stats.cold"] == pytest.approx(28.3)
+    assert snap["phases"]["cov_stats.warm"] == pytest.approx(0.21)
+    assert snap["counters"]["cov_stats_cache.hit"] == 1
+
+
+def test_trend_gates_host_phase_trajectory(capsys):
+    """The committed HOST_PHASE fixtures gate the host-phase trajectory:
+    the stable prefix passes, the test_prio drift capture regresses."""
+    assert main(["trend", *_host_phase_targets(*HP_STABLE)]) == 0
+    capsys.readouterr()
+    rc = main(
+        [
+            "trend",
+            *_host_phase_targets(*HP_STABLE, "hp05_drift.json"),
+            "--json",
+        ]
+    )
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    regressed = {r["name"] for r in doc["regressions"]}
+    assert regressed == {"test_prio"}
+
+
+def test_store_splits_prio_scoring_spans_per_variant(obs_dir, tmp_path):
+    """sa_score / sa_fit spans carrying a variant attr index as
+    per-variant feature rows; unattributed spans keep aggregating."""
+    from simple_tip_tpu.obs import store
+
+    with obs.span("sa_score", variant="dsa", dataset="nominal"):
+        pass
+    with obs.span("sa_score", variant="pc-lsa", dataset="nominal"):
+        pass
+    with obs.span("sa_score", variant="dsa", dataset="ood"):
+        pass
+    with obs.span("coverage_profiles"):
+        pass
+    obs.flush_metrics()
+
+    idx = str(tmp_path / "index")
+    store.refresh([str(obs_dir)], idx)
+    rows = [r for r in store.load_rows(idx) if r["kind"] == "obs_run"]
+    by_phase = {r["phase"]: r for r in rows}
+    assert "sa_score.dsa" in by_phase
+    assert "sa_score.pc-lsa" in by_phase
+    assert "coverage_profiles" in by_phase
+    # the two dsa spans aggregate into one per-variant feature row
+    assert by_phase["sa_score.dsa"]["count"] == 2
+    assert by_phase["sa_score.pc-lsa"]["count"] == 1
+
+
+def test_store_classifies_renamed_host_phase_captures(tmp_path):
+    """hp*-named captures (trend fixtures, archived trajectories) classify
+    by content and index as host_phase rows."""
+    from simple_tip_tpu.obs import store
+
+    idx = str(tmp_path / "index")
+    report = store.refresh([HOST_PHASE_FIXTURE], idx)
+    assert len(report["indexed"]) == 5
+    rows = store.load_rows(idx)
+    assert rows and all(r["kind"] == "host_phase" for r in rows)
+    assert {"test_prio", "train_1epoch"} <= {r["phase"] for r in rows}

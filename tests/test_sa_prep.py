@@ -330,3 +330,127 @@ def test_cache_off_knob(handler_factory, monkeypatch):
         SAFitCache.from_env("cs", 0, {"w": np.arange(2.0)}, np.zeros((2, 2)), [0])
         is None
     )
+
+
+def test_sa_fanout_knob(monkeypatch):
+    """TIP_SA_FANOUT parsing: auto follows pool_size(), 1/0 force on/off,
+    junk raises."""
+    monkeypatch.setenv("TIP_SA_POOL", "1")
+    monkeypatch.delenv("TIP_SA_FANOUT", raising=False)
+    assert not sa_prep.variant_fanout_enabled()
+    monkeypatch.setenv("TIP_SA_POOL", "4")
+    assert sa_prep.variant_fanout_enabled()
+    monkeypatch.setenv("TIP_SA_FANOUT", "0")
+    assert not sa_prep.variant_fanout_enabled()
+    monkeypatch.setenv("TIP_SA_POOL", "1")
+    monkeypatch.setenv("TIP_SA_FANOUT", "1")
+    assert sa_prep.variant_fanout_enabled()
+    monkeypatch.setenv("TIP_SA_FANOUT", "sometimes")
+    with pytest.raises(ValueError):
+        sa_prep.variant_fanout_enabled()
+
+
+def test_sa_cache_max_bytes_knob(monkeypatch):
+    """TIP_SA_CACHE_MAX_BYTES grammar: off-tokens, plain bytes, k/m/g
+    suffixes, junk raises."""
+    monkeypatch.delenv("TIP_SA_CACHE_MAX_BYTES", raising=False)
+    assert sa_prep.sa_cache_max_bytes() is None
+    for off in ("0", "off", "unlimited", "none"):
+        monkeypatch.setenv("TIP_SA_CACHE_MAX_BYTES", off)
+        assert sa_prep.sa_cache_max_bytes() is None
+    monkeypatch.setenv("TIP_SA_CACHE_MAX_BYTES", "4096")
+    assert sa_prep.sa_cache_max_bytes() == 4096
+    monkeypatch.setenv("TIP_SA_CACHE_MAX_BYTES", "64k")
+    assert sa_prep.sa_cache_max_bytes() == 64 * 1024
+    monkeypatch.setenv("TIP_SA_CACHE_MAX_BYTES", "1.5m")
+    assert sa_prep.sa_cache_max_bytes() == int(1.5 * 1024**2)
+    monkeypatch.setenv("TIP_SA_CACHE_MAX_BYTES", "2g")
+    assert sa_prep.sa_cache_max_bytes() == 2 * 1024**3
+    monkeypatch.setenv("TIP_SA_CACHE_MAX_BYTES", "lots")
+    with pytest.raises(ValueError):
+        sa_prep.sa_cache_max_bytes()
+
+
+def test_cache_sweep_evicts_lru_until_under_cap(tmp_path, monkeypatch):
+    """The sweep drops oldest-mtime entries first, stops at the cap, and
+    never evicts the just-written entry — even when it alone busts the cap."""
+    root = tmp_path / "sa_cache"
+    root.mkdir()
+    for i, name in enumerate(["old.pkl", "mid.pkl", "new.pkl"]):
+        p = root / name
+        p.write_bytes(b"x" * 100)
+        os.utime(p, (1000 + i, 1000 + i))
+    cache = SAFitCache(
+        root=str(root), case_study="cs", model_ref="0", fingerprint="f"
+    )
+    monkeypatch.setenv("TIP_SA_CACHE_MAX_BYTES", "150")
+    cache._sweep(keep=str(root / "new.pkl"))
+    assert sorted(os.listdir(root)) == ["new.pkl"]
+
+    for i, name in enumerate(["old.pkl", "mid.pkl"]):
+        p = root / name
+        p.write_bytes(b"x" * 100)
+        os.utime(p, (2000 + i, 2000 + i))
+    monkeypatch.setenv("TIP_SA_CACHE_MAX_BYTES", "1")
+    cache._sweep(keep=str(root / "old.pkl"))
+    assert sorted(os.listdir(root)) == ["old.pkl"]
+
+
+def test_cache_cap_sweeps_during_store(handler_factory, tmp_path, monkeypatch):
+    """With a cap below any single entry, every store sweeps its
+    predecessors: the dir never holds more than the newest entry."""
+    cache_dir = tmp_path / "sa_cache"
+    monkeypatch.setenv("TIP_SA_CACHE_DIR", str(cache_dir))
+    monkeypatch.setenv("TIP_SA_CACHE_MAX_BYTES", "1")
+    monkeypatch.setenv("TIP_SA_PIPELINE", "0")
+    monkeypatch.setenv("TIP_SA_POOL", "1")
+    make, datasets = handler_factory
+    make().evaluate_all(datasets)
+    assert len(os.listdir(cache_dir)) == 1
+
+
+def test_fanout_matches_serial_reference(
+    handler_factory, serial_reference, monkeypatch
+):
+    """The whole-variant fan-out path (TIP_SA_FANOUT=1 over a 2-worker
+    pool) reproduces the serial reference byte-for-byte."""
+    monkeypatch.setenv("TIP_SA_CACHE_DIR", "off")
+    monkeypatch.setenv("TIP_SA_FANOUT", "1")
+    monkeypatch.setenv("TIP_SA_POOL", "2")
+    make, datasets = handler_factory
+    _assert_identical(make().evaluate_all(datasets), serial_reference)
+
+
+def test_fanout_serves_cache_hits_without_refitting(
+    handler_factory, serial_reference, tmp_path, monkeypatch
+):
+    """A warm cache satisfies the fan-out path entirely from disk: no
+    VariantFitter is built, and results stay identical."""
+    monkeypatch.setenv("TIP_SA_CACHE_DIR", str(tmp_path / "sa_cache"))
+    monkeypatch.setenv("TIP_SA_FANOUT", "1")
+    monkeypatch.setenv("TIP_SA_POOL", "2")
+    make, datasets = handler_factory
+    make().evaluate_all(datasets)
+
+    warm = make()
+
+    def _boom(*a, **k):
+        raise AssertionError("warm fan-out must not build a fitter")
+
+    monkeypatch.setattr(warm, "_ensure_fitter", _boom)
+    _assert_identical(warm.evaluate_all(datasets), serial_reference)
+
+
+def test_fanout_memory_profile_bounds_workers(monkeypatch):
+    """fanout_workers respects the pool cap, the task count, and the
+    estimated per-variant footprint against available memory."""
+    monkeypatch.setenv("TIP_SA_POOL", "4")
+    names = ["dsa", "pc-lsa", "pc-mdsa"]
+    assert sa_prep.fanout_workers(names, 360, 12) <= 3
+    assert sa_prep.fanout_workers(names, 360, 12) >= 1
+    monkeypatch.setenv("TIP_SA_POOL", "1")
+    assert sa_prep.fanout_workers(names, 360, 12) == 1
+    # estimates grow with both n and d, and by-class LSA dominates DSA
+    assert sa_prep.estimate_variant_fit_bytes(
+        "pc-lsa", 10_000, 300
+    ) > sa_prep.estimate_variant_fit_bytes("dsa", 10_000, 300)

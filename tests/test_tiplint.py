@@ -721,6 +721,66 @@ def render(doc):
 ''',
 }
 
+BAD_IMPLICIT_TRANSFER = {
+    "engine/mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _score(badge):
+    """d."""
+    return jnp.sum(badge * badge, axis=1)
+
+
+def direct(x):
+    """Name assigned from a jnp expression, then converted."""
+    ats = jnp.stack(x)
+    return np.asarray(ats)
+
+
+def per_badge(badges):
+    """Per-badge pull of a locally-jitted call result via a name."""
+    out = []
+    for b in badges:
+        scores = _score(b)
+        out.append(np.asarray(scores))
+    return out
+'''
+}
+
+GOOD_IMPLICIT_TRANSFER = {
+    # the same dataflow outside engine/ is host code by design
+    "ops/mod.py": '''"""m."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def collect(x):
+    """d."""
+    ats = jnp.stack(x)
+    return np.asarray(ats)  # tiplint: disable=host-sync (kernel boundary)
+''',
+    "engine/clean.py": '''"""m."""
+import numpy as np
+
+
+def host_only(values):
+    """Host names convert freely; re-binding untaints."""
+    batch = np.stack(values)
+    return np.asarray(batch, dtype=np.float32)
+
+
+def rebound(x, fused):
+    """Attribute-call results and host re-bindings stay clean."""
+    scores = fused.pull(x)
+    arr = np.asarray(scores)
+    scores = np.square(arr)
+    return np.asarray(scores)
+''',
+}
+
 FIXTURES = {
     "jit-purity": (BAD_JIT_PURITY, GOOD_JIT_PURITY),
     "naked-retry": (BAD_NAKED_RETRY, GOOD_NAKED_RETRY),
@@ -728,6 +788,7 @@ FIXTURES = {
     "wallclock-duration": (BAD_WALLCLOCK, GOOD_WALLCLOCK),
     "prng-hygiene": (BAD_PRNG, GOOD_PRNG),
     "host-sync": (BAD_HOST_SYNC, GOOD_HOST_SYNC),
+    "implicit-device-transfer": (BAD_IMPLICIT_TRANSFER, GOOD_IMPLICIT_TRANSFER),
     "f64-on-tpu": (BAD_F64, GOOD_F64),
     "buffer-donation": (BAD_DONATION, GOOD_DONATION),
     "artifact-contract": (BAD_CONTRACT, GOOD_CONTRACT),
