@@ -14,7 +14,14 @@ phases never construct a case study or touch a backend):
    journaled and a RuntimeError naming run 2;
 2. phase 2 re-runs the SAME invocation with the faults cleared — the
    restarted scheduler must skip the 3 journaled runs (no new attempts)
-   and complete only run 2.
+   and complete only run 2;
+3. phase 3 runs a 2-host 2-worker-each FLEET (parallel/fleet.py) under a
+   plan that hard-kills the coordinator twice (so one host dies mid-unit
+   AND its promoted successor dies too), drops one host's heartbeats, and
+   skews the other host's clock (``TIP_FLEET_CLOCK_SKEW_S``) — the fleet
+   must still finish every unit exactly once: expired leases stolen, a
+   standby member joining late, ``fleet.handoffs >= 1`` and
+   ``lease.steals >= 1`` in the obs stream.
 
 Exit 0 when every assertion holds; nonzero (with a reason) otherwise.
 
@@ -124,24 +131,96 @@ def main() -> int:
         "only the unfinished run re-ran",
     )
 
+    def _events_blob() -> str:
+        parts = []
+        obs_dir = os.environ["TIP_OBS_DIR"]
+        for name in sorted(os.listdir(obs_dir)):
+            if name.startswith("events-") and name.endswith(".jsonl"):
+                with open(os.path.join(obs_dir, name), encoding="utf-8") as f:
+                    parts.append(f.read())
+        return "".join(parts)
+
     # The obs stream must carry the lifecycle: injected faults from the
     # workers, skip events from the resumed scheduler.
-    blob = ""
-    obs_dir = os.environ["TIP_OBS_DIR"]
-    for name in sorted(os.listdir(obs_dir)):
-        if name.startswith("events-") and name.endswith(".jsonl"):
-            with open(os.path.join(obs_dir, name), encoding="utf-8") as f:
-                blob += f.read()
+    blob = _events_blob()
     check("fault.injected" in blob, "fault injections visible in the obs stream")
     check("scheduler.skip_journaled" in blob, "journal skips visible in the obs stream")
     check("scheduler.requeue" in blob, "requeues visible in the obs stream")
+
+    # --- phase 3: host-level fleet under coordinator kills + partition ----
+    from simple_tip_tpu.parallel.fleet import run_phase_fleet
+
+    fleet_ids = list(range(16))
+    os.environ["TIP_FAULT_STATE"] = os.path.join(tmp, "fleet_fault_state")
+    os.environ["TIP_FAULT_PLAN"] = json.dumps({
+        "faults": [
+            # Kill whoever is coordinator, twice: the founding coordinator
+            # dies mid-unit, its promoted successor dies too — the standby
+            # that joins late must finish the phase.
+            {"site": "host.die", "kind": "kill",
+             "match": {"role": "coordinator"}, "times": 2},
+            # Heartbeat partition stand-in: host0 is alive but two of its
+            # beats never land.
+            {"site": "heartbeat.drop", "kind": "fail",
+             "match": {"host": "host0"}, "times": 2},
+        ]
+    })
+    t0 = time.monotonic()
+    fleet_error = ""
+    try:
+        run_phase_fleet(
+            "chaosfleet", "_test_sleep", fleet_ids,
+            root=os.path.join(tmp, "fleet"),
+            n_hosts=2, workers_per_host=2,
+            phase_kwargs={"seconds": 0.6, "marker_dir": marker},
+            lease_ttl_s=2.0, member_ttl_s=2.0, deadline_s=300.0,
+            # One member runs with a skewed clock: expiry comparisons are
+            # additive, so the skew shifts its windows without corrupting
+            # durations — and fencing, not clock agreement, guards commits.
+            member_env=[{}, {"TIP_FLEET_CLOCK_SKEW_S": "0.75"}],
+        )
+    except (RuntimeError, ValueError) as e:
+        fleet_error = str(e)
+    del os.environ["TIP_FAULT_PLAN"]
+    print(f"phase 3 (fleet) wall-clock: {time.monotonic() - t0:.1f}s")
+    check(not fleet_error, f"fleet phase completes ({fleet_error[:200]})")
+
+    fleet_done = []
+    try:
+        with open(journal_path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                rec = json.loads(line)
+                if rec.get("case_study") == "chaosfleet":
+                    fleet_done.append(rec["model_id"])
+    except OSError:
+        pass
+    check(
+        sorted(fleet_done) == fleet_ids,
+        f"journal holds every fleet unit ({sorted(set(fleet_done))})",
+    )
+    check(
+        len(fleet_done) == len(set(fleet_done)),
+        "no unit journaled twice (fenced commits are exactly-once)",
+    )
+
+    blob = _events_blob()
+    check(blob.count('"fleet.host_die"') >= 2, "both coordinator kills fired")
+    check('"fleet.handoff"' in blob, "a standby promoted to coordinator")
+    check('"lease.steal"' in blob, "expired leases were stolen")
+    check('"fleet.standby"' in blob, "an elastic standby member joined late")
+    check("fleet.heartbeats_dropped" in blob, "dropped heartbeats counted")
 
     if not args.keep:
         shutil.rmtree(tmp, ignore_errors=True)
     if failures:
         print(f"chaos smoke FAILED: {len(failures)} assertion(s)", file=sys.stderr)
         return 1
-    print("chaos smoke OK: kill+wedge handled, journaled resume completed the phase")
+    print(
+        "chaos smoke OK: kill+wedge handled, journaled resume completed the "
+        "phase, fleet survived coordinator kills with exactly-once commits"
+    )
     return 0
 
 

@@ -19,6 +19,7 @@ from simple_tip_tpu.analysis.rules import (  # noqa: F401
     shape_poly,
     sharding_spec,
     transitive_purity,
+    unfenced_claim,
     unversioned_schema,
     wallclock_duration,
 )

@@ -1,0 +1,140 @@
+"""Rule ``unfenced-claim``: a file-claim idiom with no expiry or fencing.
+
+``O_CREAT|O_EXCL`` (and the hardlink variant, ``os.link``) is the repo's
+atomic "exactly one winner" primitive — fault-injection claim markers, the
+first claim of a work lease. Used bare in library code it is a *lock with
+no way out*: the winner that crashes (this deployment's normal failure
+mode — preempted hosts, killed workers) never releases the file, so every
+later contender loses forever; and even with an expiry bolted on, a
+claim that carries no fencing epoch lets a wedged-but-alive former holder
+wake up and commit over the successor's work. That is precisely the bug
+class the lease substrate (``resilience/lease.py``) exists to close:
+expiry makes a dead holder's claim stealable, the monotonic epoch fences
+the resurrected holder out at the commit point.
+
+Detected: a call that passes an ``O_EXCL`` flag to ``os.open`` (any
+module alias, flags combined with ``|``), or any ``os.link`` call, in a
+scope whose identifiers show NO lifecycle vocabulary — nothing matching
+``lease``/``fence``/``epoch``/``expire``/``expiry``/``ttl``/``deadline``.
+The vocabulary test is deliberately loose: the rule's job is to make
+"I wrote a bare claim file" a conscious decision, not to verify the
+protocol.
+
+Exempt: ``resilience/`` (the lease/fault substrate IS the sanctioned
+implementation), plus the usual script/test surfaces — a test fixture or
+a one-shot operator script may claim freely.
+"""
+
+import ast
+from typing import Iterator, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.bare_print import _exempt
+
+#: Identifier substrings that mark a claim as lifecycle-aware: any expiry
+#: wording (a dead holder's claim can be reclaimed) or fencing wording
+#: (a stale holder's commit can be rejected).
+_LIFECYCLE_VOCAB = (
+    "lease", "fence", "epoch", "expire", "expiry", "ttl", "deadline",
+)
+
+
+def _resilience_module(module: ModuleInfo) -> bool:
+    """Whether ``module`` lives in the resilience package (the sanctioned
+    home of claim/lease machinery)."""
+    return "resilience" in module.relpath.split("/")[:-1]
+
+
+def _scope_of(tree: ast.Module, target: ast.AST) -> ast.AST:
+    """The innermost function/method enclosing ``target`` (else the module).
+
+    The vocabulary check runs over the enclosing scope: a claim helper
+    whose own code renews/expires the claim is fine even if the rest of
+    the module never mentions leases.
+    """
+    best = tree
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for child in ast.walk(node):
+            if child is target:
+                best = node  # keep walking: a nested def wins over its parent
+    return best
+
+
+def _identifiers(scope: ast.AST):
+    """Every identifier-ish string in ``scope``, lowercased."""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Name):
+            yield node.id.lower()
+        elif isinstance(node, ast.Attribute):
+            yield node.attr.lower()
+        elif isinstance(node, ast.arg):
+            yield node.arg.lower()
+        elif isinstance(node, ast.keyword) and node.arg:
+            yield node.arg.lower()
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            yield node.name.lower()
+
+
+def _has_lifecycle_vocab(scope: ast.AST) -> bool:
+    return any(
+        any(word in ident for word in _LIFECYCLE_VOCAB)
+        for ident in _identifiers(scope)
+    )
+
+
+def _is_excl_open(node: ast.AST) -> bool:
+    """An ``os.open``-style call whose flags include ``O_EXCL``."""
+    if not isinstance(node, ast.Call):
+        return False
+    return any(
+        isinstance(n, ast.Attribute) and n.attr == "O_EXCL"
+        for arg in node.args + [kw.value for kw in node.keywords]
+        for n in ast.walk(arg)
+    )
+
+
+def _is_os_link(node: ast.AST) -> bool:
+    """An ``os.link``/``link`` call (the hardlink claim idiom)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr == "link" and isinstance(f.value, ast.Name)
+    return isinstance(f, ast.Name) and f.id == "link"
+
+
+@register
+class UnfencedClaimRule(Rule):
+    """Flag O_EXCL/hardlink claim idioms lacking expiry/fencing vocabulary."""
+
+    name = "unfenced-claim"
+    description = (
+        "O_EXCL/os.link claim idiom with no expiry or fencing epoch in "
+        "library code: a crashed winner never releases the claim and a "
+        "wedged stale holder can still commit; use "
+        "resilience.lease.LeaseManager (TTL + fencing epoch) or handle "
+        "expiry/fencing in the claiming scope (resilience/, scripts/, "
+        "tests exempt)"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Flag lifecycle-blind claim calls outside the exempt surfaces."""
+        if _exempt(module) or _resilience_module(module):
+            return
+        for node in ast.walk(module.tree):
+            excl = _is_excl_open(node)
+            if not excl and not _is_os_link(node):
+                continue
+            scope = _scope_of(module.tree, node)
+            if _has_lifecycle_vocab(scope):
+                continue
+            idiom = "os.open(..., O_EXCL)" if excl else "os.link"
+            yield "", node.lineno, (
+                f"{idiom} claim with no expiry/fencing in scope: a holder "
+                "that dies never releases it (contenders lose forever) and "
+                "a wedged holder can commit stale work; claim through "
+                "resilience.lease.LeaseManager, or give the claim a TTL "
+                "and a fencing epoch"
+            )

@@ -48,6 +48,11 @@ class CoverageStatsCache:
     def __init__(self, root: str, fingerprint: str):
         self.root = root
         self.fingerprint = fingerprint
+        # Same open-path hygiene as SAFitCache: sweep aged orphan tmp
+        # files a mid-rename kill left behind in this cache dir.
+        from simple_tip_tpu.utils.artifacts_io import sweep_orphan_tmp
+
+        sweep_orphan_tmp(self.root)
 
     @classmethod
     def from_env(

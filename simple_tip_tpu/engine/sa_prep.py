@@ -485,6 +485,12 @@ class SAFitCache:
         self.case_study = case_study
         self.model_ref = model_ref
         self.fingerprint = fingerprint
+        # Open-path hygiene: a writer killed between its tmp write and the
+        # rename (artifact.write 'kill' fault, real power loss) leaks a
+        # pid-unique tmp; sweep aged ones so restarts don't accrete litter.
+        from simple_tip_tpu.utils.artifacts_io import sweep_orphan_tmp
+
+        sweep_orphan_tmp(self.root)
 
     @classmethod
     def from_env(

@@ -349,6 +349,35 @@ def _rows_from_host_phase(path: str, seq: int) -> list:
     return rows
 
 
+def _multichip_stamp(doc: dict) -> dict:
+    """The ``MULTICHIP_STAMP`` payload the dryrun printed, if any.
+
+    MULTICHIP records are composed by the external driver from the dryrun
+    process's exit code and stdout tail, so the degradation/breaker state
+    travels as a ``MULTICHIP_STAMP: {json}`` line inside ``tail`` (the
+    same at-the-source stamping bench records get directly). ``tail`` may
+    be one string or a list of lines; the last parseable stamp wins.
+    """
+    tail = doc.get("tail")
+    lines = []
+    if isinstance(tail, str):
+        lines = tail.splitlines()
+    elif isinstance(tail, (list, tuple)):
+        lines = [line for line in tail if isinstance(line, str)]
+    stamp = {}
+    for line in lines:
+        marker = line.find("MULTICHIP_STAMP:")
+        if marker < 0:
+            continue
+        try:
+            parsed = json.loads(line[marker + len("MULTICHIP_STAMP:"):])
+        except ValueError:
+            continue
+        if isinstance(parsed, dict):
+            stamp = parsed
+    return stamp
+
+
 def _rows_from_multichip(path: str, seq: int) -> list:
     """One summary row per ``MULTICHIP_r*.json`` capture."""
     try:
@@ -362,7 +391,19 @@ def _rows_from_multichip(path: str, seq: int) -> list:
     row["run"] = os.path.splitext(os.path.basename(path))[0]
     row["phase"] = "multichip.capture"
     row["count"] = doc.get("n_devices", 1)
-    row["degraded"] = not bool(doc.get("ok", False))
+    # Degraded iff the capture failed OR the dryrun stamped a degradation
+    # (CPU fallback, open breaker) — stamps ride ``tail`` (see above), but
+    # explicit top-level keys from a newer driver win over the parse.
+    stamp = _multichip_stamp(doc)
+    reason = doc.get("degraded_reason", stamp.get("degraded_reason"))
+    breaker = doc.get("breaker", stamp.get("breaker"))
+    breaker_open = isinstance(breaker, dict) and breaker.get("state") == "open"
+    row["degraded"] = (
+        not bool(doc.get("ok", False))
+        or bool(stamp.get("degraded"))
+        or bool(reason)
+        or breaker_open
+    )
     return [row]
 
 

@@ -49,10 +49,15 @@ import multiprocessing as mp
 import os
 import queue as queue_mod
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 from simple_tip_tpu import obs
-from simple_tip_tpu.resilience import RetryPolicy, faults, journal_from_env
+from simple_tip_tpu.resilience import (
+    LeaseLost,
+    RetryPolicy,
+    faults,
+    journal_from_env,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -307,6 +312,7 @@ def run_phase_parallel(
     phase_kwargs: Optional[Dict] = None,
     worker_platforms: Optional[List[str]] = None,
     run_timeout_s: Optional[float] = None,
+    fleet=None,
 ) -> None:
     """Run ``phase`` for ``model_ids`` across ``num_workers`` processes.
 
@@ -316,6 +322,17 @@ def run_phase_parallel(
     fresh CPU-pinned worker. Raises ``RuntimeError`` at the end if any id
     failed, naming every failed id and its error; completed ids keep their
     artifacts either way.
+
+    ``fleet`` (a :class:`~simple_tip_tpu.parallel.fleet.FleetContext`)
+    switches the claim path onto file-backed leases so MULTIPLE host-level
+    schedulers can share one phase: ids are enqueued only after this host
+    wins their lease (late joiners steal expired leases), in-flight leases
+    are renewed every tick, completions commit through the journal with a
+    fencing token (a host whose lease was stolen cannot double-commit),
+    failures are released for cross-host retry up to the fleet attempt
+    budget (``TIP_RETRY_FLEET_*``), and ids finished or failed on OTHER
+    hosts count toward completion. Fleet mode requires a journal (pin
+    ``TIP_ASSETS`` or ``TIP_JOURNAL``) — it is the single commit point.
     """
     if phase not in PHASES:
         raise ValueError(f"unknown phase {phase!r}; one of {sorted(PHASES)}")
@@ -329,6 +346,11 @@ def run_phase_parallel(
     # restart-safe SAFitCache/artifact bus back to warm state. Off unless
     # the bus is pinned (TIP_ASSETS) or TIP_JOURNAL names a path.
     journal = journal_from_env(case_study, phase)
+    if fleet is not None and journal is None:
+        raise ValueError(
+            "fleet execution requires a journal as the commit point: pin "
+            "TIP_ASSETS or set TIP_JOURNAL to a shared path"
+        )
     already_done = journal.completed() if journal is not None else set()
     skipped = [m for m in model_ids if m in already_done]
     pending = [m for m in model_ids if m not in already_done]
@@ -351,9 +373,16 @@ def run_phase_parallel(
     # CPU-pinned worker, then fail). Only the scoped TIP_RETRY_SCHED_*
     # knobs tune it (inherit=False): requeues cost a whole run_timeout_s
     # each, so a blanket TIP_RETRY_ATTEMPTS bump for cache/probe IO must
-    # not silently multiply hour-long wedge retries.
+    # not silently multiply hour-long wedge retries. Under a fleet the
+    # budget is promoted to host scope (TIP_RETRY_FLEET_*): local requeues
+    # AND cross-host lease epochs draw from the same attempt contract.
     max_requeues = (
-        RetryPolicy.from_env(scope="sched", inherit=False, attempts=2).attempts - 1
+        RetryPolicy.from_env(
+            scope="fleet" if fleet is not None else "sched",
+            inherit=False,
+            attempts=2,
+        ).attempts
+        - 1
     )
 
     # Resolve the obs run directory BEFORE any spawn: an ``auto``
@@ -397,9 +426,13 @@ def run_phase_parallel(
     retry_q = ctx.Queue()
     done_q = ctx.Queue()
     stop_event = ctx.Event()
-    for m in pending:
-        work_q.put(m)
-        obs.event("scheduler.announce", model_id=m, phase=phase)
+    if fleet is None:
+        for m in pending:
+            work_q.put(m)
+            obs.event("scheduler.announce", model_id=m, phase=phase)
+    # Fleet mode enqueues nothing up front: an id reaches work_q only once
+    # THIS host wins its lease (see _fleet_tick below), so two members
+    # sharing a phase partition the ids instead of both running all of them.
 
     workers: List = []
     worker_queue: Dict[int, object] = {}  # pid -> the queue that worker reads
@@ -441,6 +474,64 @@ def run_phase_parallel(
     in_flight: Dict[int, Dict] = {}  # id -> {"pid", "deadline"}
     requeues: Dict[int, int] = {}  # id -> requeue count so far
 
+    # Fleet-mode state. ``claimed`` holds the fence token for every id whose
+    # lease THIS host currently owns (renewed each tick, presented at the
+    # journal commit). ``done_elsewhere``/``failed_elsewhere`` are ids some
+    # OTHER member resolved — they count toward completion here without
+    # ever entering ``results``.
+    claimed: Dict[int, object] = {}
+    done_elsewhere: Set[int] = set()
+    failed_elsewhere: Dict[int, str] = {}
+
+    def _outstanding() -> List[int]:
+        """Ids nobody (here or elsewhere) has resolved yet."""
+        return [
+            m
+            for m in model_ids
+            if m not in results
+            and m not in done_elsewhere
+            and m not in failed_elsewhere
+        ]
+
+    def _fleet_tick() -> None:
+        """One fleet housekeeping pass: heartbeat + coordinator duties,
+        refresh the elsewhere view, claim unowned ids, renew held leases."""
+        if fleet is None:
+            return
+        fleet.tick(workers)
+        done_else, failed_else = fleet.elsewhere()
+        for m in done_else:
+            if m not in results and m not in claimed:
+                done_elsewhere.add(m)
+        for m, err in failed_else.items():
+            if m not in results and m not in claimed and m not in done_elsewhere:
+                failed_elsewhere[m] = err
+        for m in pending:
+            if (
+                m in results
+                or m in claimed
+                or m in done_elsewhere
+                or m in failed_elsewhere
+            ):
+                continue
+            tok = fleet.try_claim(m)
+            if tok is None:
+                continue  # leased to (or failed on) another member
+            claimed[m] = tok
+            work_q.put(m)
+            obs.event("scheduler.announce", model_id=m, phase=phase)
+        for m, tok in list(claimed.items()):
+            if m in results:
+                continue
+            try:
+                fleet.renew(tok)
+            except LeaseLost:
+                # Stolen mid-run (our lease expired, or a straggler
+                # speculation re-leased it). Keep the claim entry: the
+                # fenced journal commit — not this loop — decides whether
+                # our in-progress attempt still counts.
+                obs.counter("lease.lost_renewals").inc()
+
     def _handle(msg) -> None:
         kind, model_id, payload = msg
         if kind == "start":
@@ -458,6 +549,58 @@ def run_phase_parallel(
         in_flight.pop(model_id, None)
         if model_id in results:
             return  # late duplicate after a requeue race; first report wins
+        if fleet is not None:
+            if payload is None:
+                # Fenced commit: the journal is the single commit point. A
+                # host whose lease was stolen mid-run (expired while wedged,
+                # speculative re-lease of a straggler) is rejected HERE — its
+                # finished work is discarded, the stealer's commit stands,
+                # and every unit lands in the journal exactly once.
+                tok = claimed.pop(model_id, None)
+                try:
+                    if tok is None:
+                        raise LeaseLost(f"no live lease held for run {model_id}")
+                    journal.mark_done(model_id, fence=tok)
+                except LeaseLost as e:
+                    obs.counter("lease.fence_rejects").inc()
+                    obs.event(
+                        "scheduler.fence_reject", model_id=model_id,
+                        phase=phase, error=str(e)[:200],
+                    )
+                    logger.warning(
+                        "[%s] %s: run %d finished but its lease was lost "
+                        "(%s); discarding — the stealing host owns this unit",
+                        case_study, phase, model_id, e,
+                    )
+                    return
+                fleet.release(tok)
+                results[model_id] = None
+                logger.info("[%s] %s: run %d done", case_study, phase, model_id)
+                obs.event("scheduler.done", model_id=model_id, phase=phase)
+            else:
+                tok = claimed.pop(model_id, None)
+                final = fleet.report_failure(model_id, tok, str(payload))
+                if final is not None:
+                    results[model_id] = final
+                    logger.error(
+                        "[%s] %s: run %d FAILED fleet-wide: %s",
+                        case_study, phase, model_id, final,
+                    )
+                    obs.event(
+                        "scheduler.fail", model_id=model_id, phase=phase,
+                        error=str(final)[:300],
+                    )
+                else:
+                    logger.warning(
+                        "[%s] %s: run %d failed here (%s); lease released "
+                        "for retry on another member",
+                        case_study, phase, model_id, payload,
+                    )
+                    obs.event(
+                        "scheduler.release_retry", model_id=model_id,
+                        phase=phase, error=str(payload)[:200],
+                    )
+            return
         results[model_id] = payload
         if payload is None:
             logger.info("[%s] %s: run %d done", case_study, phase, model_id)
@@ -500,13 +643,36 @@ def run_phase_parallel(
             # A reaped work_q worker leaves the main pool one short; without a
             # replacement, still-unclaimed ids on work_q would strand behind
             # the stall timeout (or be abandoned outright on a 1-worker pool).
-            outstanding = len(model_ids) - len(results) - len(in_flight)
+            outstanding = len(_outstanding()) - len(in_flight)
             if w is not None and worker_queue.get(w.pid) is work_q and outstanding > 1:
                 _spawn("cpu")  # reads work_q
             if model_id in results:
                 continue  # a first attempt already reported; nothing to redo
             n = requeues.get(model_id, 0)
             if n >= max_requeues:
+                if fleet is not None:
+                    # Local budget spent: hand the unit back to the fleet.
+                    # Another member retries it (or it fails fleet-wide once
+                    # the shared attempt budget is gone).
+                    tok = claimed.pop(model_id, None)
+                    final = fleet.report_failure(model_id, tok, reason)
+                    if final is not None:
+                        results[model_id] = final
+                        logger.error(
+                            "[%s] %s: run %d FAILED fleet-wide: %s",
+                            case_study, phase, model_id, final,
+                        )
+                    else:
+                        logger.warning(
+                            "[%s] %s: run %d local requeues spent (%s); "
+                            "lease released for retry on another member",
+                            case_study, phase, model_id, reason,
+                        )
+                        obs.event(
+                            "scheduler.release_retry", model_id=model_id,
+                            phase=phase, error=reason[:200],
+                        )
+                    continue
                 spent = "once" if n == 1 else f"{n} times"
                 results[model_id] = f"{reason}; already requeued {spent} — giving up"
                 logger.error(
@@ -541,7 +707,8 @@ def run_phase_parallel(
     mempoll_s = float(os.environ.get("TIP_OBS_MEMPOLL_S", str(_DEFAULT_MEMPOLL_S)))
     last_mempoll = time.monotonic()
 
-    while len(results) < len(model_ids):
+    while _outstanding():
+        _fleet_tick()
         if (
             mempoll_s > 0
             and obs.enabled()
@@ -558,6 +725,11 @@ def run_phase_parallel(
         _reap_stuck()
         if in_flight:
             last_progress = time.monotonic()  # per-id deadlines own this case
+        elif fleet is not None and not claimed:
+            # Every unresolved id is leased to another member: waiting on
+            # the fleet to finish (or on an expiry we can steal) is
+            # progress, not a local stall.
+            last_progress = time.monotonic()
         elif time.monotonic() - last_progress > stall_timeout_s:
             alive = [w for w in workers if w.is_alive()]
             if alive and not startup_rescued:
@@ -587,7 +759,7 @@ def run_phase_parallel(
                     _handle(done_q.get_nowait())
                 except queue_mod.Empty:
                     break
-            if len(results) < len(model_ids):
+            if _outstanding():
                 break
 
     stop_event.set()
@@ -597,10 +769,31 @@ def run_phase_parallel(
             logger.error("worker pid %s wedged at shutdown; terminating", w.pid)
             w.terminate()
 
+    if fleet is not None:
+        # Clean leaver: requeue any claim we still hold so surviving members
+        # pick those ids up immediately instead of waiting out the lease TTL.
+        for m, tok in list(claimed.items()):
+            if m in results:
+                continue
+            try:
+                fleet.release(tok)
+            except Exception:  # noqa: BLE001 — best-effort; expiry is the backstop
+                pass
+        claimed.clear()
+
+    span_extra = (
+        dict(
+            done_elsewhere=len(done_elsewhere),
+            failed_elsewhere=len(failed_elsewhere),
+        )
+        if fleet is not None
+        else {}
+    )
     phase_span.set(
         completed=sum(1 for e in results.values() if e is None),
         failed=sum(1 for e in results.values() if e is not None),
         actual_s=round(time.perf_counter() - phase_started, 3),
+        **span_extra,
     ).__exit__(None, None, None)
     # Final high-water sample even for phases shorter than the poll period.
     if obs.enabled():
@@ -608,7 +801,12 @@ def run_phase_parallel(
     obs.flush_metrics()
 
     failed = {m: e for m, e in results.items() if e is not None}
-    missing = [m for m in model_ids if m not in results]
+    failed.update(failed_elsewhere)
+    missing = [
+        m
+        for m in model_ids
+        if m not in results and m not in done_elsewhere and m not in failed_elsewhere
+    ]
     if failed or missing:
         parts = [f"run {m}: {e}" for m, e in sorted(failed.items())]
         parts += [f"run {m}: worker died without reporting" for m in missing]

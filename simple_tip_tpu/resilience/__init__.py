@@ -28,7 +28,12 @@ scheduler workers, the bench parent and the tier-0 chaos smoke job):
 - ``breaker``  a closed/open/half-open circuit breaker over the backend
   probe (``TIP_BREAKER_*``): an open breaker fails fast or *loudly*
   degrades to CPU, stamping the degradation into bench records and
-  health counters at the source.
+  health counters at the source;
+- ``lease``    file-backed work leases with monotonic fencing epochs and
+  heartbeat membership — the host fault domain: a preempted host's
+  expired claims are stealable by any member, a stolen lease's stale
+  holder is fenced out at the journal commit, and the coordinator role
+  itself is just one more lease any standby can take over.
 """
 
 from simple_tip_tpu.resilience.breaker import (
@@ -43,18 +48,32 @@ from simple_tip_tpu.resilience.faults import (
     maybe_inject,
 )
 from simple_tip_tpu.resilience.journal import RunJournal, journal_from_env
+from simple_tip_tpu.resilience.lease import (
+    COORDINATOR_UNIT,
+    FenceToken,
+    LeaseLost,
+    LeaseManager,
+    Membership,
+    fleet_now,
+)
 from simple_tip_tpu.resilience.retry import RetryGiveUp, RetryPolicy
 
 __all__ = [
     "BackendUnavailable",
+    "COORDINATOR_UNIT",
     "CircuitBreaker",
     "FaultPlan",
+    "FenceToken",
     "InjectedFault",
+    "LeaseLost",
+    "LeaseManager",
+    "Membership",
     "RetryGiveUp",
     "RetryPolicy",
     "RunJournal",
     "active_plan",
     "corrupt_file",
+    "fleet_now",
     "journal_from_env",
     "maybe_inject",
 ]

@@ -38,7 +38,16 @@ production code; the plan decides whether anything happens there:
 - ``artifact.write``  an atomic bus write (``torn`` = partial tmp write
                       then error; ``kill`` = partial tmp write then
                       ``os._exit`` — the mid-write kill);
-- ``journal.append``  a resume-journal append (``torn`` tears the line).
+- ``journal.append``  a resume-journal append (``torn`` tears the line);
+- ``host.die``        a fleet member's tick (``kill``: the member
+                      terminates its worker pool and hard-exits — host
+                      preemption; matchable on ``host``/``role``/``tick``
+                      so "kill whoever is coordinator" is expressible);
+- ``heartbeat.drop``  a membership heartbeat write (``fail`` eats the
+                      beat: the host is alive but the fleet stops seeing
+                      it — the heartbeat-partition stand-in);
+- ``lease.steal``     a lease takeover attempt (``fail`` denies it — a
+                      standby that cannot take over; ``error`` raises).
 
 Kinds (``KINDS``): ``die``/``wedge``/``error`` are process-level and
 execute directly inside ``fire``; ``timeout``/``fail``/``corrupt``/
@@ -75,6 +84,9 @@ SITES = (
     "sa_cache.load",
     "artifact.write",
     "journal.append",
+    "host.die",
+    "heartbeat.drop",
+    "lease.steal",
 )
 
 #: Process-level kinds executed by fire() itself, and seam-interpreted

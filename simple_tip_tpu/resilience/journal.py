@@ -16,6 +16,26 @@ fsync — a mid-append kill leaves at most one torn tail line, which the
 reader skips and counts. No rewrite-in-place ever happens, so no kill can
 eat *previous* completions.
 
+**Fencing (fleet mode).** The journal is the single commit point of the
+fleet layer (resilience/lease.py): ``mark_done(fence=...)`` validates the
+caller's lease token immediately before the append, under the journal
+lock, and re-checks the unit is not already journaled — so a host whose
+lease was stolen (preempted, wedged, clock-skewed) CANNOT append a stale
+completion, and a stealer racing the original holder commits exactly
+once. Fenced commits raise :class:`~..lease.LeaseLost` instead of
+appending (the one deliberate exception to "the journal never raises":
+fencing is correctness, not acceleration).
+
+**Compaction.** Across a 400-run study with restarts the append-only file
+grows without bound; with ``TIP_JOURNAL_MAX_BYTES`` set, an append that
+pushes the file past the cap rewrites it as a deduplicated snapshot of
+completed units (same JSONL schema, tmp + fsync + atomic rename — the
+torn-tail rules are preserved because the snapshot is born whole). The
+append and the compaction both hold the journal flock, so a concurrent
+appender on another host can never land a line on the doomed inode.
+Without the cap (and without a fence) the historical lock-free
+single-writer append path is unchanged.
+
 Resolution (``journal_from_env``): ``TIP_JOURNAL`` = ``off``/``0``
 disables; an explicit path is used verbatim; unset/``auto`` journals under
 ``$TIP_ASSETS/journal/runs.jsonl`` — but only when ``TIP_ASSETS`` itself
@@ -23,10 +43,13 @@ is pinned, because journaling into an implicit CWD-relative bus would leak
 completion state between unrelated invocations (exactly the kind of
 cross-test contamination the scheduler tests would hit). Semantics: a
 journal entry means "this (case study, phase, id) finished once under this
-bus"; delete the file (or the bus) to force a full re-run.
+bus"; delete the file (or the bus) to force a full re-run. Opening the
+journal also sweeps aged orphan ``*.tmp`` files in its directory (a kill
+between an atomic writer's write and rename leaks them).
 
-Stdlib-only; single-writer by construction (only the scheduler parent
-appends; workers report over the done queue).
+Stdlib-only. Single-writer by construction in the plain scheduler path;
+multi-host appends (fleet mode) are safe because O_APPEND line writes are
+atomic and fenced commits serialize on the journal lock.
 """
 
 import json
@@ -38,7 +61,24 @@ from typing import Optional, Set
 from simple_tip_tpu import obs
 from simple_tip_tpu.resilience import faults
 
+try:
+    import fcntl
+except ImportError:  # pragma: no cover — non-POSIX
+    fcntl = None
+
 logger = logging.getLogger(__name__)
+
+
+def journal_max_bytes() -> int:
+    """The ``TIP_JOURNAL_MAX_BYTES`` compaction trigger (0 = off)."""
+    raw = os.environ.get("TIP_JOURNAL_MAX_BYTES", "").strip()
+    if not raw:
+        return 0
+    try:
+        return max(0, int(float(raw)))
+    except ValueError:
+        logger.warning("TIP_JOURNAL_MAX_BYTES=%r is not a number; ignoring", raw)
+        return 0
 
 
 class RunJournal:
@@ -49,13 +89,11 @@ class RunJournal:
         self.case_study = case_study
         self.phase = phase
 
-    def completed(self) -> Set:
-        """Model ids journaled as done for this (case study, phase).
+    # -- reading -----------------------------------------------------------
 
-        Torn tail lines (a kill mid-append) and foreign entries are
-        skipped; a missing journal is simply the empty set.
-        """
-        done: Set = set()
+    def _records(self) -> list:
+        """Every parseable record in the journal, torn tails skipped."""
+        out = []
         try:
             with open(self.path, encoding="utf-8") as f:
                 for line in f:
@@ -66,20 +104,90 @@ class RunJournal:
                         rec = json.loads(line)
                     except ValueError:
                         continue  # torn tail from a crash mid-append
-                    if (
-                        isinstance(rec, dict)
-                        and rec.get("case_study") == self.case_study
-                        and rec.get("phase") == self.phase
-                        and "model_id" in rec
-                    ):
-                        done.add(rec["model_id"])
+                    if isinstance(rec, dict):
+                        out.append(rec)
         except OSError:
-            return set()
+            return []
+        return out
+
+    def completed(self) -> Set:
+        """Model ids journaled as done for this (case study, phase).
+
+        Torn tail lines (a kill mid-append) and foreign entries are
+        skipped; a missing journal is simply the empty set.
+        """
+        done: Set = set()
+        for rec in self._records():
+            if (
+                rec.get("case_study") == self.case_study
+                and rec.get("phase") == self.phase
+                and "model_id" in rec
+            ):
+                done.add(rec["model_id"])
         return done
 
-    def mark_done(self, model_id) -> None:
-        """Append one completion line (fsync'd; failures warn, never raise
-        — the journal accelerates restarts, it must not fail the phase)."""
+    # -- locking -----------------------------------------------------------
+
+    def _locked(self):
+        """Journal flock (sidecar ``.lock`` file): held by fenced commits
+        and by compaction, so neither can race the other's rename."""
+        path = self.path + ".lock"
+        journal = self
+
+        class _Lock:
+            def __enter__(self):
+                os.makedirs(os.path.dirname(journal.path) or ".", exist_ok=True)
+                self.fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+                if fcntl is not None:
+                    fcntl.flock(self.fd, fcntl.LOCK_EX)
+                return self
+
+            def __exit__(self, *exc):
+                try:
+                    if fcntl is not None:
+                        fcntl.flock(self.fd, fcntl.LOCK_UN)
+                finally:
+                    os.close(self.fd)
+                return False
+
+        return _Lock()
+
+    # -- writing -----------------------------------------------------------
+
+    def mark_done(self, model_id, fence=None) -> None:
+        """Append one completion line (fsync'd).
+
+        Plain appends warn and never raise — the journal accelerates
+        restarts, it must not fail the phase. With ``fence`` (a lease
+        :class:`~..lease.FenceToken`), this is the fleet commit point:
+        under the journal lock the fence is validated (raising
+        ``LeaseLost`` for a stolen lease — the stale host cannot commit)
+        and an already-journaled unit is skipped, so every unit commits
+        exactly once no matter how many hosts raced it.
+        """
+        if fence is not None:
+            with self._locked():
+                if model_id in self.completed():
+                    # A stealer (or the original holder) already committed
+                    # this unit; a second line would be a double completion.
+                    obs.counter("journal.dup_skips").inc()
+                    logger.info(
+                        "journal: unit %s already committed; skipping duplicate",
+                        model_id,
+                    )
+                    return
+                fence.check()  # raises LeaseLost for a fenced-out holder
+                self._append(model_id, epoch=fence.epoch)
+                self._maybe_compact_locked()
+            return
+        if journal_max_bytes():
+            with self._locked():
+                self._append(model_id)
+                self._maybe_compact_locked()
+        else:
+            self._append(model_id)
+
+    def _append(self, model_id, epoch: Optional[int] = None) -> None:
         rec = {
             "case_study": self.case_study,
             "phase": self.phase,
@@ -87,6 +195,8 @@ class RunJournal:
             "ts": time.time(),
             "pid": os.getpid(),
         }
+        if epoch is not None:
+            rec["epoch"] = int(epoch)
         line = json.dumps(rec, sort_keys=True) + "\n"
         data = line.encode("utf-8")
         fault = faults.maybe_inject(
@@ -113,6 +223,52 @@ class RunJournal:
         except OSError as e:
             logger.warning("resume journal append failed (%s): %s", self.path, e)
 
+    def _maybe_compact_locked(self) -> None:
+        """Compact the journal if it outgrew ``TIP_JOURNAL_MAX_BYTES``.
+
+        Caller holds the journal lock. The snapshot keeps ONE record per
+        (case_study, phase, model_id) across ALL pairs sharing the file
+        (first completion wins — later lines are restart duplicates), and
+        lands via tmp + fsync + atomic rename, so a kill mid-compaction
+        leaves the old journal intact.
+        """
+        cap = journal_max_bytes()
+        if not cap:
+            return
+        try:
+            size = os.stat(self.path).st_size
+        except OSError:
+            return
+        if size <= cap:
+            return
+        try:
+            seen, kept = set(), []
+            for rec in self._records():
+                key = (rec.get("case_study"), rec.get("phase"), rec.get("model_id"))
+                if "model_id" not in rec or key in seen:
+                    continue
+                seen.add(key)
+                kept.append(rec)
+            tmp = f"{self.path}.{os.getpid()}.tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                for rec in kept:
+                    f.write(json.dumps(rec, sort_keys=True) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            after = os.stat(self.path).st_size
+            obs.counter("journal.compactions").inc()
+            obs.event(
+                "journal.compact", path=self.path, before_bytes=size,
+                after_bytes=after, records=len(kept),
+            )
+            logger.info(
+                "journal compacted: %s %d -> %d bytes (%d unique completions)",
+                self.path, size, after, len(kept),
+            )
+        except OSError as e:
+            logger.warning("journal compaction failed (%s): %s", self.path, e)
+
 
 def journal_from_env(case_study: str, phase: str) -> Optional[RunJournal]:
     """The configured journal, or None when journaling is off (see module
@@ -121,10 +277,19 @@ def journal_from_env(case_study: str, phase: str) -> Optional[RunJournal]:
     if raw.lower() in ("off", "0"):
         return None
     if raw and raw.lower() not in ("auto", "1", "on"):
-        return RunJournal(raw, case_study, phase)
+        return _opened(RunJournal(raw, case_study, phase))
     if not os.environ.get("TIP_ASSETS", "").strip():
         return None  # no pinned bus: journaling would leak across runs
     from simple_tip_tpu.config import output_folder
 
     path = os.path.join(output_folder(), "journal", "runs.jsonl")
-    return RunJournal(path, case_study, phase)
+    return _opened(RunJournal(path, case_study, phase))
+
+
+def _opened(journal: RunJournal) -> RunJournal:
+    """Open-path hygiene: sweep aged orphan tmp files next to the journal
+    (an atomic writer killed between write and rename leaks them)."""
+    from simple_tip_tpu.utils.artifacts_io import sweep_orphan_tmp
+
+    sweep_orphan_tmp(os.path.dirname(journal.path) or ".")
+    return journal

@@ -20,10 +20,71 @@ exactly what the kill-during-store test asserts.
 import json
 import logging
 import os
+import re
+import time
 
 from simple_tip_tpu.resilience import faults
 
 logger = logging.getLogger(__name__)
+
+#: The tmp-file idiom every atomic writer in this repo uses: ``<base>.<pid>.tmp``.
+#: The sweep matches ONLY this shape so it can never eat foreign files.
+_ORPHAN_TMP_RE = re.compile(r"\.\d+\.tmp$")
+
+#: Default age gate for the orphan sweep: anything younger may belong to a
+#: live writer mid-rename; an hour-old tmp is a kill leftover.
+DEFAULT_TMP_SWEEP_AGE_S = 3600.0
+
+
+def sweep_orphan_tmp(directory: str, max_age_s: float = None) -> int:
+    """Remove aged ``*.<pid>.tmp`` orphans in ``directory`` (same-dir only,
+    never recursive). Returns the number removed.
+
+    ``atomic_write_bytes`` cleans its tmp on every *exception* path, but a
+    kill between the write and the rename (the ``artifact.write`` ``kill``
+    fault, a real power loss) leaks it — harmless individually, unbounded
+    across a long study's restarts. Journal/cache/bus open paths call this
+    with the default age gate (``TIP_TMP_SWEEP_AGE_S``, 3600 s): old
+    enough that no live writer — pid-unique and seconds-lived — can still
+    own the file.
+    """
+    if max_age_s is None:
+        raw = os.environ.get("TIP_TMP_SWEEP_AGE_S", "").strip()
+        try:
+            max_age_s = float(raw) if raw else DEFAULT_TMP_SWEEP_AGE_S
+        except ValueError:
+            max_age_s = DEFAULT_TMP_SWEEP_AGE_S
+    removed = 0
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    now = time.time()
+    for name in names:
+        if not _ORPHAN_TMP_RE.search(name):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            if now - os.stat(path).st_mtime < max_age_s:
+                continue
+            os.remove(path)
+            removed += 1
+        except OSError:
+            continue  # raced a concurrent sweep/writer: benign
+    if removed:
+        obs_counter_inc("artifacts.tmp_swept", removed)
+        logger.info(
+            "swept %d orphan tmp file(s) from %s (kill leftovers)",
+            removed, directory,
+        )
+    return removed
+
+
+def obs_counter_inc(name: str, n: int) -> None:
+    """Late-bound obs counter bump (keeps the module import-light)."""
+    from simple_tip_tpu import obs
+
+    obs.counter(name).inc(n)
 
 
 def atomic_write_bytes(path: str, data: bytes) -> None:

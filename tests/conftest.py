@@ -22,6 +22,19 @@ import sys
 for _var in ("TIP_OBS_DIR", "TIP_OBS_ROOT", "TIP_OBS_SAMPLE", "TIP_OBS_MAX_BYTES"):
     os.environ.pop(_var, None)
 
+# An inherited fault plan (a developer mid-chaos-debug, a CI job that
+# exported one for the smoke) would inject faults into EVERY scheduler/
+# journal/lease touch the suite makes; inherited retry/fleet knobs would
+# silently rescale attempt budgets and timeouts the tests pin. Clear them
+# all at session start — tests that need them set them per-test.
+for _var in ("TIP_FAULT_PLAN", "TIP_FAULT_STATE"):
+    os.environ.pop(_var, None)
+for _var in [
+    v for v in os.environ
+    if v.startswith("TIP_RETRY_") or v.startswith("TIP_FLEET_")
+]:
+    os.environ.pop(_var, None)
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1164,3 +1164,53 @@ def test_store_classifies_renamed_host_phase_captures(tmp_path):
     rows = store.load_rows(idx)
     assert rows and all(r["kind"] == "host_phase" for r in rows)
     assert {"test_prio", "train_1epoch"} <= {r["phase"] for r in rows}
+
+
+def test_store_multichip_stamp_marks_degraded_rows(tmp_path):
+    """ISSUE 11 satellite: the dryrun's ``MULTICHIP_STAMP`` line (riding
+    the driver-composed ``tail``) flags breaker-open/degraded captures so
+    trend gating never grades them as real mesh numbers."""
+    from simple_tip_tpu.obs import store
+
+    def capture(name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    sources = [
+        capture("MULTICHIP_r01.json", {
+            "ok": True, "n_devices": 8,
+            "tail": 'dryrun_multichip OK: trained\n'
+                    'MULTICHIP_STAMP: {"degraded": false}',
+        }),
+        capture("MULTICHIP_r02.json", {
+            "ok": True, "n_devices": 8,
+            "tail": ["dryrun_multichip OK: trained",
+                     'MULTICHIP_STAMP: {"degraded": true, '
+                     '"degraded_reason": "breaker-open"}'],
+        }),
+        capture("MULTICHIP_r03.json", {
+            "ok": True, "n_devices": 8,
+            "tail": 'MULTICHIP_STAMP: {"degraded": false, '
+                    '"breaker": {"state": "open"}}',
+        }),
+        capture("MULTICHIP_r04.json", {
+            "ok": True, "n_devices": 8,
+            "degraded_reason": "tunnel-outage", "tail": "no stamp printed",
+        }),
+    ]
+    idx = str(tmp_path / "index")
+    store.refresh(sources, idx)
+    rows = {
+        os.path.basename(r["source"]): r
+        for r in store.load_rows(idx) if r["kind"] == "multichip"
+    }
+    assert len(rows) == 4
+    assert rows["MULTICHIP_r01.json"]["degraded"] is False
+    assert rows["MULTICHIP_r02.json"]["degraded"] is True
+    assert rows["MULTICHIP_r03.json"]["degraded"] is True, (
+        "an open breaker degrades even an ok capture"
+    )
+    assert rows["MULTICHIP_r04.json"]["degraded"] is True, (
+        "explicit driver-composed keys win without a stamp"
+    )

@@ -21,6 +21,7 @@ import os
 import pickle
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -47,6 +48,8 @@ def _clean_resilience_env(monkeypatch):
         "TIP_FAULT_PLAN",
         "TIP_FAULT_STATE",
         "TIP_JOURNAL",
+        "TIP_JOURNAL_MAX_BYTES",
+        "TIP_TMP_SWEEP_AGE_S",
         "TIP_BREAKER_STATE",
         "TIP_BREAKER_THRESHOLD",
         "TIP_BREAKER_COOLDOWN_S",
@@ -259,6 +262,98 @@ def test_journal_torn_append_fault(tmp_path, monkeypatch):
     assert j.completed() == {0}, "the torn entry must read as absent"
     j.mark_done(1)
     assert j.completed() == {0, 1}
+
+
+def test_journal_compaction_dedupes_across_processes(tmp_path, monkeypatch):
+    """ISSUE 11 satellite: with ``TIP_JOURNAL_MAX_BYTES`` set, an append
+    that pushes the file past the cap rewrites it as a deduplicated
+    snapshot — including restart duplicates appended by ANOTHER process."""
+    path = str(tmp_path / "runs.jsonl")
+    code = (
+        "import sys\n"
+        "from simple_tip_tpu.resilience import RunJournal\n"
+        "j = RunJournal(sys.argv[1], 'mnist', 'test_prio')\n"
+        "for _ in range(3):\n"  # three 'restarts' re-journaling the same runs
+        "    for i in range(20):\n"
+        "        j.mark_done(i)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code, path],
+        capture_output=True, text=True,
+        env=dict(os.environ), timeout=120, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr
+    j = RunJournal(path, "mnist", "test_prio")
+    before = os.stat(path).st_size
+    assert len(j._records()) == 60
+    monkeypatch.setenv("TIP_JOURNAL_MAX_BYTES", "512")
+    j.mark_done(99)  # the over-cap append triggers the compaction
+    assert os.stat(path).st_size < before
+    assert j.completed() == set(range(20)) | {99}, (
+        "compaction must never lose a completion"
+    )
+    keys = [
+        (r.get("case_study"), r.get("phase"), r.get("model_id"))
+        for r in j._records()
+    ]
+    assert len(keys) == len(set(keys)), "the snapshot keeps one record per unit"
+    assert metrics.snapshot()["counters"].get("journal.compactions") == 1
+
+
+# --- orphan tmp sweep --------------------------------------------------------
+
+
+def test_orphan_tmp_sweep_is_age_gated_and_shape_matched(tmp_path):
+    from simple_tip_tpu.utils.artifacts_io import sweep_orphan_tmp
+
+    aged = tmp_path / "runs.jsonl.12345.tmp"
+    aged.write_text("{half a reco")
+    os.utime(aged, (time.time() - 7200, time.time() - 7200))
+    fresh = tmp_path / "runs.jsonl.9999.tmp"
+    fresh.write_text("a live writer owns this")
+    foreign = tmp_path / "notes.tmp"  # not the <base>.<pid>.tmp shape
+    foreign.write_text("keep")
+    os.utime(foreign, (time.time() - 7200, time.time() - 7200))
+    assert sweep_orphan_tmp(str(tmp_path)) == 1
+    assert not aged.exists()
+    assert fresh.exists(), "anything younger than the gate may be mid-rename"
+    assert foreign.exists(), "the sweep must never eat foreign files"
+    assert metrics.snapshot()["counters"].get("artifacts.tmp_swept") == 1
+
+
+def test_kill_mid_write_leaks_tmp_journal_open_sweeps_it(tmp_path, monkeypatch):
+    """The kill seam end-to-end: a process killed between write and rename
+    leaks its pid-unique tmp (the exception-path cleanup cannot run), and
+    the journal open path reclaims it once it ages past the gate."""
+    target_dir = tmp_path / "journal"
+    target_dir.mkdir()
+    target = str(target_dir / "runs.jsonl")
+    code = (
+        "import sys\n"
+        "from simple_tip_tpu.utils.artifacts_io import atomic_write_bytes\n"
+        "atomic_write_bytes(sys.argv[1], b'x' * 64)\n"
+    )
+    env = dict(
+        os.environ,
+        TIP_FAULT_STATE=str(tmp_path / "state"),
+        TIP_FAULT_PLAN=json.dumps({"faults": [
+            {"site": "artifact.write", "kind": "kill", "times": 1},
+        ]}),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code, target],
+        capture_output=True, text=True, env=env, timeout=120, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 1
+    assert not os.path.exists(target), "the destination never sees the kill"
+    orphans = [n for n in os.listdir(target_dir) if n.endswith(".tmp")]
+    assert len(orphans) == 1, "the mid-write kill must leak exactly one tmp"
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path))
+    monkeypatch.setenv("TIP_TMP_SWEEP_AGE_S", "0")
+    assert journal_from_env("mnist", "test_prio") is not None
+    assert not any(
+        n.endswith(".tmp") for n in os.listdir(target_dir)
+    ), "opening the journal must sweep the aged orphan"
 
 
 # --- circuit breaker ---------------------------------------------------------

@@ -781,6 +781,48 @@ def rebound(x, fused):
 ''',
 }
 
+BAD_UNFENCED_CLAIM = {
+    "claims.py": '''"""Bare claim idioms: atomic winner, no way out."""
+import os
+
+
+def grab_slot(path):
+    """O_EXCL claim with no expiry or fencing anywhere in scope."""
+    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    os.close(fd)
+    return True
+
+
+def link_claim(src, dst):
+    """The hardlink variant of the same bug."""
+    os.link(src, dst)
+    return dst
+''',
+}
+
+GOOD_UNFENCED_CLAIM = {
+    "claims.py": '''"""Lifecycle-aware claims stay clean."""
+import os
+import time
+
+
+def claim_lease(path, ttl_s, epoch):
+    """Expiry + fencing vocabulary in scope: a conscious lease claim."""
+    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    os.write(fd, str(time.time() + ttl_s).encode())
+    os.write(fd, str(epoch).encode())
+    os.close(fd)
+    return epoch
+
+
+def copy_tree(os_module, src, dst):
+    """os.link used for plain hardlinking data, inside a leased scope."""
+    lease_deadline = time.time() + 30
+    os_module.link(src, dst)
+    return lease_deadline
+''',
+}
+
 FIXTURES = {
     "jit-purity": (BAD_JIT_PURITY, GOOD_JIT_PURITY),
     "naked-retry": (BAD_NAKED_RETRY, GOOD_NAKED_RETRY),
@@ -796,6 +838,7 @@ FIXTURES = {
     "sharding-spec-mismatch": (BAD_SHARDING, GOOD_SHARDING),
     "shape-polymorphism": (BAD_SHAPE_POLY, GOOD_SHAPE_POLY),
     "transitive-jit-purity": (BAD_TRANSITIVE, GOOD_TRANSITIVE),
+    "unfenced-claim": (BAD_UNFENCED_CLAIM, GOOD_UNFENCED_CLAIM),
     "unversioned-schema": (BAD_UNVERSIONED_SCHEMA, GOOD_UNVERSIONED_SCHEMA),
 }
 
