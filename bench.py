@@ -36,7 +36,10 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...},
 including a ``sa_fit_seconds`` companion (five-variant surprise-adequacy
 fit wall-clock through the engine's shared-prep path at a small fixed
 shape — the prio phase's dominant host cost per HOST_PHASE.json;
-``TIP_BENCH_SA=0`` skips it), an ``obs_overhead_seconds`` companion
+``TIP_BENCH_SA=0`` skips it), a ``fused_chain`` companion (whole-chain AOT
+run-program throughput, first-walk vs steady-state compile counts and the
+host-transfer bytes/input analytic vs the per-phase activation pull;
+``TIP_BENCH_FUSED_CHAIN=0`` skips it), an ``obs_overhead_seconds`` companion
 (seconds per 1000 obs span cycles in the current TIP_OBS_DIR state, so the
 trajectory catches telemetry regressions) and the process's obs metrics
 snapshot (``obs_metrics``: compile counts, watchdog probe outcomes, ...).
@@ -279,6 +282,79 @@ def _child_measure() -> None:
         except Exception as e:  # noqa: BLE001 — record, never fail the bench
             sa_fit_info = {"error": repr(e)[:300]}
 
+    # Fused-chain companion: price the whole-chain AOT run program
+    # (engine/run_program.py — predict + quantify + 12-metric profile pack
+    # in ONE dispatch per badge, greedy CAM in one dispatch per metric)
+    # against the per-phase dispatch structure the main metric measures.
+    # Records inputs/s on the steady-state walk, compile counts for the
+    # first walk vs steady state (the ``jax.compiles`` monitoring counter),
+    # and the analytic host-transfer bytes/input next to what the per-phase
+    # coverage path moves (every tapped f32 activation) — the number the
+    # trend gate watches to keep the chain fused. TIP_BENCH_FUSED_CHAIN=0
+    # skips; failures record an error, never take the bench down.
+    fused_chain_info = None
+    if os.environ.get("TIP_BENCH_FUSED_CHAIN", "1").strip().lower() not in (
+        "0",
+        "off",
+    ):
+        try:
+            from simple_tip_tpu.engine.run_program import FusedChainRunner
+
+            fc_rng = np.random.default_rng(2)
+            fc_train = fc_rng.normal(size=(256, 28, 28, 1)).astype(np.float32)
+            n_fc, fc_badge = (256, 128) if on_cpu else (4096, 2048)
+            fc_test = fc_rng.normal(size=(n_fc, 28, 28, 1)).astype(np.float32)
+            runner = FusedChainRunner(
+                model,
+                params,
+                fc_train,
+                model.nc_layers,
+                batch_size=fc_badge,
+                badge_size=fc_badge,
+                cache=None,  # price the compile honestly, not a disk hit
+            )
+            c0 = obs.metrics_snapshot()["counters"]
+            runner.evaluate_dataset(fc_test)  # first walk: AOT compiles
+            c1 = obs.metrics_snapshot()["counters"]
+            t0 = time.perf_counter()
+            runner.evaluate_dataset(fc_test)  # steady state: cached programs
+            fc_dt = time.perf_counter() - t0
+            c2 = obs.metrics_snapshot()["counters"]
+
+            def _delta(a, b, name):
+                return b.get(name, 0) - a.get(name, 0)
+
+            _, fc_taps = model.apply(
+                {"params": params}, jnp.asarray(fc_test[:1]), train=False
+            )
+            n_neurons = sum(
+                int(np.prod(np.asarray(fc_taps[i]).shape[1:]))
+                for i in model.nc_layers
+            )
+            n_metrics = len(runner.worker.metrics)
+            # fused walk drains pred (i4) + 4 quantifiers (f32) + per-metric
+            # scores (f32-equivalent); packed profiles stay device-resident
+            fused_bytes = 4 + 4 * 4 + n_metrics * 4
+            fused_chain_info = {
+                "inputs_per_sec": round(n_fc / fc_dt, 1) if fc_dt > 0 else 0.0,
+                "n_inputs": n_fc,
+                "badge_size": fc_badge,
+                "n_metrics": n_metrics,
+                "compiles_first_walk": _delta(c0, c1, "jax.compiles"),
+                "compiles_steady_state": _delta(c1, c2, "jax.compiles"),
+                "chain_dispatches": _delta(
+                    c1, c2, "run_program.chain_dispatches"
+                ),
+                "rank_dispatches": _delta(c1, c2, "run_program.rank_dispatches"),
+                "host_transfer_bytes_per_input": fused_bytes,
+                # contrast: the per-phase coverage path moves every tapped
+                # f32 activation to host before packing
+                "per_phase_host_bytes_per_input_estimate": n_neurons * 4
+                + fused_bytes,
+            }
+        except Exception as e:  # noqa: BLE001 — record, never fail the bench
+            fused_chain_info = {"error": repr(e)[:300]}
+
     # Telemetry-overhead companion: seconds per 1000 span enter/exit cycles
     # in the CURRENT obs state (normally disabled — the no-op path the
     # pipeline pays everywhere when TIP_OBS_DIR is unset). The trajectory
@@ -326,6 +402,11 @@ def _child_measure() -> None:
                 **(
                     {"sa_fit_seconds": sa_fit_info}
                     if sa_fit_info is not None
+                    else {}
+                ),
+                **(
+                    {"fused_chain": fused_chain_info}
+                    if fused_chain_info is not None
                     else {}
                 ),
                 "degraded": bool(on_cpu),

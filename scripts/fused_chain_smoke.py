@@ -1,0 +1,109 @@
+"""CI parity smoke: fused-chain ranks must equal the per-phase reference.
+
+Runs the tiny seeded synthetic case study through BOTH prioritization
+paths — the per-phase reference (``_eval_fault_predictors`` +
+``_eval_neuron_coverage``) and the whole-chain fused run program
+(``_eval_fused_chain``, the ``TIP_FUSED_CHAIN=1`` path) — into two separate
+artifact roots, then diffs the artifact sets:
+
+- predictions, every coverage metric's scores and CAM order: byte-identical;
+- uncertainty values: allclose within float ULPs (XLA vs host-numpy log
+  rounding) AND an identical stable descending ordering — the consumer
+  contract (ops/uncertainty.py).
+
+Exit 0 on parity, 1 with a named diff otherwise. CPU-safe and small enough
+for a CI lane (~1 min); the same pin runs as a tier-1 test
+(tests/test_run_program.py::test_fused_artifacts_match_per_phase) — this
+script exists so the LINT lane catches a parity break without waiting for
+the full suite.
+
+Usage: python scripts/fused_chain_smoke.py
+"""
+
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    import numpy as np
+
+    import jax
+
+    from simple_tip_tpu.engine import eval_prioritization as ep
+    from simple_tip_tpu.models.convnet import MnistConvNet
+    from simple_tip_tpu.models.train import init_params
+
+    case_study, model_id, layers = "smoke", 0, (0, 1, 2, 3)
+    rng = np.random.RandomState(7)
+    model = MnistConvNet(num_classes=4)
+    x_train = rng.rand(64, 16, 16, 1).astype(np.float32)
+    x_nom = rng.rand(40, 16, 16, 1).astype(np.float32)
+    x_ood = rng.rand(24, 16, 16, 1).astype(np.float32)
+    y_nom = rng.randint(0, 4, size=40)
+    y_ood = rng.randint(0, 4, size=24)
+    params = init_params(model, jax.random.PRNGKey(1), x_train[:2])
+
+    def artifacts():
+        from simple_tip_tpu.config import subdir
+
+        out = {}
+        for name in sorted(os.listdir(subdir("priorities"))):
+            out[name] = np.load(os.path.join(subdir("priorities"), name))
+        return out
+
+    with tempfile.TemporaryDirectory() as tmp:
+        os.environ["TIP_ASSETS"] = os.path.join(tmp, "per_phase")
+        for ds, labels, ds_type in ((x_nom, y_nom, "nominal"), (x_ood, y_ood, "ood")):
+            ep._eval_fault_predictors(
+                case_study, model, params, model_id, ds, labels, ds_type, 32
+            )
+        ep._eval_neuron_coverage(
+            case_study, model, params, model_id, layers, x_nom, x_ood, x_train, 32
+        )
+        ref = artifacts()
+
+        os.environ["TIP_ASSETS"] = os.path.join(tmp, "fused")
+        ep._eval_fused_chain(
+            case_study, model, params, model_id, layers,
+            x_nom, y_nom, x_ood, y_ood, x_train, 32,
+        )
+        got = artifacts()
+
+    if set(ref) != set(got):
+        print(
+            "FUSED-CHAIN PARITY FAIL: artifact sets differ\n"
+            f"  per-phase only: {sorted(set(ref) - set(got))}\n"
+            f"  fused only:     {sorted(set(got) - set(ref))}"
+        )
+        return 1
+    failures = []
+    for name in sorted(ref):
+        r, g = ref[name], got[name]
+        if "_uncertainty_" in name:
+            same_order = np.array_equal(
+                np.argsort(-r, kind="stable"), np.argsort(-g, kind="stable")
+            )
+            if not (np.allclose(g, r, rtol=0, atol=1e-6) and same_order):
+                failures.append(name)
+        elif not np.array_equal(r, g):
+            failures.append(name)
+    if failures:
+        print(f"FUSED-CHAIN PARITY FAIL: {len(failures)} artifacts diverge:")
+        for name in failures:
+            print(f"  {name}")
+        return 1
+    print(
+        f"FUSED-CHAIN PARITY OK: {len(ref)} artifacts "
+        "(ranks/scores/pred byte-identical, uncertainties ULP-close + same order)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,7 @@ from simple_tip_tpu.analysis.rules import (  # noqa: F401
     jit_purity,
     naked_retry,
     prng_hygiene,
+    retrace_risk,
     shape_poly,
     sharding_spec,
     transitive_purity,

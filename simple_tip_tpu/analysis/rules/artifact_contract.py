@@ -49,6 +49,7 @@ EXEMPT_BUSES = {
     ".tmp",
     "sa_fit_cache",
     "coverage_stats_cache",
+    "program_cache",
 }
 WRITER_PREFIXES = ("engine/",)
 READER_PREFIXES = ("plotters/", "utils/")

@@ -1,0 +1,148 @@
+"""Rule ``retrace-risk``: jitted callables rebuilt per iteration or per call.
+
+The fused run-program layer exists to give each (case-study, model-group,
+badge-shape) ONE compiled program; the failure mode it must not reintroduce
+is the silent per-badge retrace. Two syntactic shapes produce it:
+
+1. transform construction inside a loop body::
+
+       for badge in badges:
+           fn = jax.jit(score)        # fresh PjitFunction per iteration
+           out.append(fn(badge))      # ...so every call traces from scratch
+
+   jit caches traces on the *callable object*; a new object per iteration
+   has an empty cache every time. The fix is hoisting the construction out
+   of the loop (or module level), as models/train.py's lru_cached factories
+   do.
+
+2. inline construct-and-call::
+
+       out = jax.jit(score)(badge)    # the traced program is dropped here
+
+   the jitted object lives for one call, so a second execution of the
+   enclosing statement retraces — the same defect with the loop supplied by
+   the caller.
+
+Both flag regardless of what the arguments are: a callable whose trace
+cache cannot outlive one iteration is a retrace risk even when today's
+shapes happen to be constant (the per-badge Python-scalar key — ``valid``
+counts, remainder badge sizes — is exactly what creeps in next).
+
+Only the JIT FAMILY is tracked (``jax.jit``/``jax.pjit``/``jax.pmap``):
+those are the transforms that own an XLA compile cache keyed on the
+callable object. Trace-time combinators (``vmap``, ``grad``,
+``pallas_call``, ``lax.scan``) constructed inline are idiomatic — they
+trace as part of whatever program encloses them and carry no cache to
+lose. For the same reason, a jit constructed INSIDE an already
+jit-reachable function is excluded (nested jit is inlined into the outer
+trace), and decorated defs inside loops are fine; only transform CALL
+expressions are tracked.
+"""
+
+import ast
+from typing import Iterator, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.common import (
+    callee_name,
+    dotted,
+    import_aliases,
+    is_partial_of,
+    jit_reachable_functions,
+    parent_map,
+)
+
+#: Transforms whose result owns a compile cache (the retrace-able kind).
+_JIT_FAMILY = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.pmap",
+    "jax.experimental.pjit.pjit",
+}
+
+
+def _is_jit_construction(node: ast.Call, aliases) -> bool:
+    """A call expression that BUILDS a compile-cached callable.
+
+    Covers ``jax.jit(f)``, ``partial(jax.jit, ...)(f)`` and
+    ``jax.jit(static_argnames=...)``-style configured constructions.
+    """
+    name = callee_name(node, aliases)
+    if name in _JIT_FAMILY:
+        return True
+    func = node.func
+    if isinstance(func, ast.Call):
+        if callee_name(func, aliases) in _JIT_FAMILY:
+            return True
+        return any(is_partial_of(func, t, aliases) for t in _JIT_FAMILY)
+    return False
+
+
+@register
+class RetraceRiskRule(Rule):
+    """Flag jit/vmap/etc. construction inside loops and construct-and-call."""
+
+    name = "retrace-risk"
+    description = (
+        "JAX transform constructed inside a loop body or immediately "
+        "called inline: the traced-callable cache dies with the object, so "
+        "every iteration/call retraces — hoist the construction (module "
+        "level, __init__, or an lru_cached factory)"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Walk call expressions; flag jit constructions whose compile
+        cache cannot outlive one loop iteration or one statement."""
+        aliases = import_aliases(module.tree)
+        parents = parent_map(module.tree)
+        traced = jit_reachable_functions(module.tree, aliases)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            inline = isinstance(node.func, ast.Call) and _is_jit_construction(
+                node.func, aliases
+            )
+            construction = inline or _is_jit_construction(node, aliases)
+            if not construction:
+                continue
+            if self._inside_traced(node, parents, traced):
+                continue  # nested jit inlines into the enclosing trace
+            if inline:
+                name = dotted(node.func.func, aliases) or "jax.jit"
+                yield "", node.lineno, (
+                    f"{name}(...) constructed and called inline: the "
+                    "compiled program is discarded after this call and "
+                    "every execution retraces; bind the jitted callable "
+                    "once and reuse it"
+                )
+                continue
+            # jit construction inside a for/while body — but not when a
+            # def/lambda boundary sits between the loop and the call (the
+            # nested function may be constructed once and called later)
+            walker = parents.get(node)
+            while walker is not None:
+                if isinstance(
+                    walker,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    break
+                if isinstance(walker, (ast.For, ast.AsyncFor, ast.While)):
+                    name = dotted(node.func, aliases) or "jax.jit"
+                    yield "", node.lineno, (
+                        f"{name}(...) constructed inside a loop body: a "
+                        "fresh jitted callable per iteration has an empty "
+                        "compile cache, so every iteration retraces (the "
+                        "per-badge retrace the program cache exists to "
+                        "prevent); hoist the construction out of the loop"
+                    )
+                    break
+                walker = parents.get(walker)
+
+    @staticmethod
+    def _inside_traced(node, parents, traced) -> bool:
+        walker = parents.get(node)
+        while walker is not None:
+            if walker in traced:
+                return True
+            walker = parents.get(walker)
+        return False

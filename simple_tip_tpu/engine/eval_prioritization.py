@@ -21,6 +21,7 @@ from simple_tip_tpu.config import subdir
 from simple_tip_tpu.engine.coverage_handler import CoverageWorker
 from simple_tip_tpu.engine.model_handler import BaseModel
 from simple_tip_tpu.engine.surprise_handler import SurpriseHandler
+from simple_tip_tpu.utils.artifacts_io import atomic_write_bytes
 
 
 def _persist(case_study: str, dataset_id: str, data_type: str, model_id: int, data):
@@ -48,8 +49,9 @@ def _persist_times(
     path = os.path.join(
         subdir("times"), f"{case_study}_{dataset_id}_{model_id}_{metric}"
     )
-    with open(path, "wb") as f:
-        pickle.dump(data, f)
+    # atomic like every other prio-path writer: a reader (or a resumed run)
+    # can never observe a torn pickle from a killed worker
+    atomic_write_bytes(path, pickle.dumps(data))
 
 
 def load(case_study: str, dataset_id: str, data_type: str, model_id: int) -> np.ndarray:
@@ -78,6 +80,39 @@ def evaluate(
     batch_size: int = 32,
 ) -> None:
     """Run the test-prioritization experiments for one trained model."""
+    from simple_tip_tpu.engine.run_program import fused_chain_enabled
+
+    if fused_chain_enabled():
+        # one AOT-compiled chain program replaces the fault-predictor and
+        # neuron-coverage phases; surprise adequacy stays per-phase (its
+        # variant fits are host sklearn estimators, not XLA-loweable)
+        with obs.span("prio.fused_chain", model_id=model_id):
+            _eval_fused_chain(
+                case_study,
+                model_def,
+                params,
+                model_id,
+                nc_activation_layers,
+                nominal_test_dataset,
+                nominal_test_labels,
+                ood_test_dataset,
+                ood_test_labels,
+                training_dataset,
+                batch_size,
+            )
+        with obs.span("prio.surprise", model_id=model_id):
+            _eval_surprise(
+                case_study,
+                model_def,
+                params,
+                model_id,
+                sa_activation_layers,
+                nominal_test_dataset,
+                ood_test_dataset,
+                training_dataset,
+                dsa_badge_size=dsa_badge_size,
+            )
+        return
     with obs.span("prio.fault_predictors", model_id=model_id, ds="nominal"):
         _eval_fault_predictors(
             case_study,
@@ -180,6 +215,62 @@ def _eval_neuron_coverage(
             _persist(case_study, name, f"{metric_id}_scores", model_id, score)
         for metric_id, order in cam_orders.items():
             _persist(case_study, name, f"{metric_id}_cam_order", model_id, np.array(order))
+
+
+def _eval_fused_chain(
+    case_study,
+    model_def,
+    params,
+    model_id,
+    nc_layers,
+    nominal_test_dataset,
+    nominal_test_labels,
+    ood_test_dataset,
+    ood_test_labels,
+    training_dataset,
+    batch_size,
+):
+    """Fused-dispatch replacement for fault predictors + neuron coverage.
+
+    Persists the IDENTICAL artifact set the two per-phase functions write
+    (is_misclassified, uncertainty_{id}, {metric}_scores, {metric}_cam_order,
+    per-metric times), from one compiled chain dispatch per badge plus one
+    rank dispatch per metric. CAM orders are byte-identical to the per-phase
+    reference; uncertainty VALUES may differ from the host-numpy quantifiers
+    by float ULPs (XLA vs numpy log rounding) with identical ordering —
+    downstream consumers depend only on the ordering (see ops/uncertainty.py).
+    """
+    from simple_tip_tpu.engine.run_program import FusedChainRunner
+
+    runner = FusedChainRunner(
+        model_def,
+        params,
+        training_dataset,
+        nc_layers,
+        batch_size=batch_size,
+    )
+    datasets = {
+        "nominal": (nominal_test_dataset, nominal_test_labels),
+        "ood": (ood_test_dataset, ood_test_labels),
+    }
+    for ds_type, (ds, labels) in datasets.items():
+        result = runner.evaluate_dataset(ds, rng=jax.random.PRNGKey(model_id))
+        is_misclassified = result["pred"] != np.asarray(labels).flatten()
+        _persist(case_study, ds_type, "is_misclassified", model_id, is_misclassified)
+        _persist_times_multiple_metrics(
+            case_study, ds_type, model_id, result["unc_times"]
+        )
+        for unc_id, unc in result["uncertainties"].items():
+            _persist(case_study, ds_type, f"uncertainty_{unc_id}", model_id, unc)
+        _persist_times_multiple_metrics(
+            case_study, ds_type, model_id, result["cov_times"]
+        )
+        for metric_id, score in result["scores"].items():
+            _persist(case_study, ds_type, f"{metric_id}_scores", model_id, score)
+        for metric_id, order in result["cam_orders"].items():
+            _persist(
+                case_study, ds_type, f"{metric_id}_cam_order", model_id, np.array(order)
+            )
 
 
 def _eval_fault_predictors(

@@ -1,0 +1,278 @@
+"""Whole-chain fused run program: predict -> quantify -> rank in ONE trace.
+
+SCALING.md's roofline puts the flagship path at 7.9% MFU because every
+per-run phase (predict, quantify, rank) is a separate Python-driven dispatch
+whose intermediates round-trip through host memory. This module builds the
+pure functions that collapse the chain: one traced program maps a badge of
+inputs to predictions, every point uncertainty quantifier, and every
+coverage metric's (scores, bit-packed profiles) — activations never leave
+the device — and a second small program runs the greedy CAM phase over the
+accumulated packed profiles. ``engine/run_program.py`` AOT-compiles and
+caches these; this module stays jax-pure so it can be lowered, vmapped over
+G-run ensemble groups, and tested in isolation.
+
+Exact int8 profile coding (``ThresholdCodebook``): NAC/NBC/SNAC/KMNC are all
+per-neuron threshold comparisons, so each neuron's activation can be recoded
+as the COUNT of passed cutpoints — an int8 — from which every metric bit is
+recovered by integer comparisons against precomputed ranks. The coding is
+EXACT (each cut is the same float comparison the plain metrics perform, and
+passing a higher cut implies passing all lower ones), so parity tests can
+assert bit-identical scores and profiles with the codebook on; what changes
+is the bytes: the 12-metric derivation reads a 1-byte code per neuron
+instead of re-reading the f32 activation per metric family.
+"""
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from simple_tip_tpu.ops.coverage import (
+    KMNC,
+    NAC,
+    NBC,
+    SNAC,
+    flatten_layers,
+    sum_score,
+)
+from simple_tip_tpu.ops.prioritizers import device_cam_greedy
+from simple_tip_tpu.ops.uncertainty import POINT_PRED_QUANTIFIERS
+
+
+def pack_bits_u32(flat):
+    """Bit-pack a traced boolean [B, W] matrix into [B, ceil(W/32)] uint32.
+
+    Same layout as ``prioritizers.pack_profiles`` (bit j of word k = section
+    32*k + j), so the packed output feeds ``device_cam_greedy`` directly and
+    cross-checks against the host packer in tests.
+    """
+    import jax.numpy as jnp
+
+    b, w = flat.shape
+    pad = (-w) % 32
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((b, pad), bool)], axis=1)
+    bits = flat.reshape(b, -1, 32).astype(jnp.uint32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(bits << shifts[None, None, :], axis=2, dtype=jnp.uint32)
+
+
+class ThresholdCodebook:
+    """Exact int8 interval coding of the threshold-family coverage metrics.
+
+    Build-time (host numpy): collect every cutpoint the configured
+    NAC/NBC/SNAC/KMNC instances compare against as a (value, strict) pair
+    per neuron, sorted so that *passing* is monotone — at equal values the
+    inclusive ``>=`` cut sorts before the strict ``>`` cut, so an activation
+    passing any cut passes all lower-ranked ones (the prefix property).
+
+    Trace-time (``apply``): one comparison sweep yields the per-neuron pass
+    count — the int8 code — and every metric's bits derive from it:
+
+    - ``a > t`` / ``a >= t``  <=>  ``code > rank(cut)``
+    - NBC low (``a <= min_b``) <=> ``code <= rank`` guarded by ``~isnan(a)``
+      (the inverted comparison would otherwise fire on NaN activations)
+    - KMNC bucket i (``e_i <= a < e_{i+1}``) <=>
+      ``(code > rank(e_i)) & (code <= rank(e_{i+1}))``
+
+    TKNC is rank-based (top-k), not threshold-based, and stays on its own
+    formulation.
+    """
+
+    #: metric families the codebook can recode
+    FAMILIES = (NAC, NBC, SNAC, KMNC)
+
+    def __init__(self, metrics: Dict[str, object]):
+        self._cuts: List[Tuple[object, bool]] = []  # (value scalar/[N], strict)
+        self._specs: Dict[str, tuple] = {}
+        for mid, m in metrics.items():
+            if isinstance(m, NAC):
+                self._specs[mid] = ("ge", self._cut(m.cov_threshold, True))
+            elif isinstance(m, SNAC):
+                self._specs[mid] = ("ge", self._cut(m.max_boundaries, False))
+            elif isinstance(m, NBC):
+                self._specs[mid] = (
+                    "nbc",
+                    self._cut(m.min_boundaries, True),
+                    self._cut(m.max_boundaries, False),
+                )
+            elif isinstance(m, KMNC):
+                edges = [m.lo + m.jumps * i for i in range(m.sections + 1)]
+                self._specs[mid] = ("kmnc", [self._cut(e, False) for e in edges])
+        if len(self._cuts) > 127:
+            raise ValueError(
+                f"{len(self._cuts)} cutpoints exceed the int8 code range"
+            )
+        self._finalized: Dict[int, tuple] = {}
+
+    def _cut(self, value, strict: bool) -> int:
+        self._cuts.append((value, strict))
+        return len(self._cuts) - 1
+
+    def covers(self, mid: str) -> bool:
+        """True when this metric's bits derive from the code."""
+        return mid in self._specs
+
+    def _ensure(self, n_neurons: int):
+        """Per-neuron sorted cut table + per-cut ranks (host numpy, cached
+        per neuron count — one table per traced activation width)."""
+        cached = self._finalized.get(n_neurons)
+        if cached is not None:
+            return cached
+        vals = np.stack(
+            [
+                np.broadcast_to(np.asarray(v, np.float64).reshape(-1), (n_neurons,))  # tiplint: disable=f64-on-tpu (host cut-table build; exact lexsort of threshold values)
+                if np.ndim(v)
+                else np.full((n_neurons,), float(v))
+                for v, _ in self._cuts
+            ],
+            axis=1,
+        )  # [N, K]
+        strict = np.array([s for _, s in self._cuts], dtype=bool)  # [K]
+        strict_b = np.broadcast_to(strict, vals.shape)
+        # primary key: cut value; secondary: strictness (inclusive first),
+        # which is exactly the order that makes pass-sets prefix-closed
+        order = np.lexsort((strict_b, vals), axis=1)
+        rank = np.argsort(order, axis=1).astype(np.int32)  # [N, K]: cut j -> rank
+        sorted_vals = np.take_along_axis(vals, order, axis=1)
+        sorted_strict = np.take_along_axis(strict_b, order, axis=1)
+        entry = (sorted_vals, sorted_strict, rank)
+        self._finalized[n_neurons] = entry
+        return entry
+
+    def apply(self, flat_acts) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+        """``{metric_id: (scores, bool profiles)}`` from one coded sweep.
+
+        ``flat_acts``: traced [B, N] activation matrix (``flatten_layers``
+        output). Profile shapes match the plain metrics' outputs exactly.
+        """
+        import jax.numpy as jnp
+
+        sorted_vals, sorted_strict, rank = self._ensure(flat_acts.shape[1])
+        a = flat_acts[:, :, None]
+        passed = jnp.where(
+            sorted_strict[None], a > sorted_vals[None], a >= sorted_vals[None]
+        )
+        # THE quantized representation: one byte per neuron carries every
+        # threshold metric's information through the rest of the program.
+        code = jnp.sum(passed, axis=2, dtype=jnp.int32).astype(jnp.int8)
+        code = code.astype(jnp.int32)  # widen once for the rank comparisons
+        nan = jnp.isnan(flat_acts)
+        out = {}
+        for mid, spec in self._specs.items():
+            if spec[0] == "ge":
+                prof = code > rank[:, spec[1]][None]
+            elif spec[0] == "nbc":
+                low = (code <= rank[:, spec[1]][None]) & ~nan
+                high = code > rank[:, spec[2]][None]
+                prof = jnp.stack([low, high], axis=-1)
+            else:  # kmnc
+                rs = [rank[:, i][None] for i in spec[1]]
+                prof = jnp.stack(
+                    [
+                        (code > rs[i]) & (code <= rs[i + 1])
+                        for i in range(len(rs) - 1)
+                    ],
+                    axis=-1,
+                )
+            out[mid] = (sum_score(prof), prof)
+        return out
+
+
+def make_chain_fn(
+    model_def,
+    layer_ids: Sequence,
+    metrics: Dict[str, object],
+    quantifiers: Optional[Dict] = None,
+    int8_profiles: bool = False,
+):
+    """The whole-chain function ``(params, x, valid) -> (pred, unc, cov)``.
+
+    One trace covers the forward pass, every point uncertainty quantifier
+    on the softmax outputs, and every coverage metric's (scores, packed
+    uint32 profiles) over the tapped activations. ``valid`` is a TRACED
+    int32 scalar: rows at index >= valid are badge padding (the engine pads
+    the final partial badge so ONE compiled shape serves the whole walk —
+    no per-remainder retrace) and get all-zero packed profiles so they can
+    never be picked by the CAM phase downstream. Their scores/uncertainties
+    are garbage the caller slices off on host.
+
+    Returns are raw device values: ``pred`` [B] argmax, ``unc`` a dict of
+    [B] uncertainty arrays (same registry keys as the per-phase path), and
+    ``cov`` a dict of ``(scores, packed)`` per metric id.
+    """
+    import jax.numpy as jnp
+
+    quantifiers = dict(POINT_PRED_QUANTIFIERS if quantifiers is None else quantifiers)
+    layer_ids = tuple(i for i in layer_ids if isinstance(i, int))
+    codebook = ThresholdCodebook(metrics) if int8_profiles else None
+
+    def chain(params, xb, valid):
+        probs, taps = model_def.apply({"params": params}, xb, train=False)
+        acts = [taps[i] for i in layer_ids]
+        pred = jnp.argmax(probs, axis=1)
+        unc = {name: fn(probs)[1] for name, fn in quantifiers.items()}
+        mask = jnp.arange(xb.shape[0]) < valid
+        coded = (
+            codebook.apply(flatten_layers(acts)) if codebook is not None else {}
+        )
+        cov = {}
+        for mid, metric in metrics.items():
+            s, p = coded[mid] if mid in coded else metric(acts)
+            packed = pack_bits_u32(p.reshape((p.shape[0], -1)))
+            cov[mid] = (s, jnp.where(mask[:, None], packed, jnp.uint32(0)))
+        return pred, unc, cov
+
+    return chain
+
+
+def make_group_chain_fn(
+    model_def,
+    layer_ids: Sequence,
+    metrics: Dict[str, object],
+    quantifiers: Optional[Dict] = None,
+    int8_profiles: bool = False,
+):
+    """The chain vmapped over a leading G-run ensemble-group axis.
+
+    ``(stacked_params, x, valid) -> (pred [G,B], unc {name: [G,B]}, cov
+    {mid: ([G,B], [G,B,W])})`` — one dispatch scores a whole device-resident
+    run group against the same badge (parallel/ensemble.py's stacked-params
+    layout).
+    """
+    import jax
+
+    chain = make_chain_fn(
+        model_def,
+        layer_ids,
+        metrics,
+        quantifiers=quantifiers,
+        int8_profiles=int8_profiles,
+    )
+    return jax.vmap(chain, in_axes=(0, None, None))
+
+
+def rank_badges(badges):
+    """Greedy CAM picks over a tuple of equally-shaped packed badges.
+
+    Concatenating INSIDE the traced program (rather than dispatching a
+    host-driven ``jnp.concatenate`` per metric) keeps the rank step at one
+    compiled program per (badge count, word width) regardless of how many
+    metrics share the shape. Returns ``(picked, count)`` as
+    ``device_cam_greedy`` does; badge-padding rows are all-zero (see
+    ``make_chain_fn``) so they are unpickable by construction.
+    """
+    import jax.numpy as jnp
+
+    badges = list(badges)
+    packed = badges[0] if len(badges) == 1 else jnp.concatenate(badges, axis=0)
+    return device_cam_greedy(packed, packed.shape[0])
+
+
+def rank_badges_grouped(badges):
+    """``rank_badges`` vmapped over a leading G-group axis ([G, B, W] badges)."""
+    import jax
+    import jax.numpy as jnp
+
+    badges = list(badges)
+    packed = badges[0] if len(badges) == 1 else jnp.concatenate(badges, axis=1)
+    return jax.vmap(lambda p: device_cam_greedy(p, p.shape[0]))(packed)

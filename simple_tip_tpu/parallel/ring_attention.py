@@ -122,19 +122,36 @@ def check_ring_divisibility(seq_len: int, n_dev: int) -> None:
         )
 
 
-def sharded_attention(q, k, v, mesh: Mesh, axis: str, kernel_fn):
-    """Shared scaffolding for the sequence-parallel attention wrappers:
-    shard q/k/v over ``axis`` of ``mesh`` and run ``kernel_fn`` (a per-shard
-    collective taking (q, k, v)) under shard_map + jit."""
+@functools.lru_cache(maxsize=32)
+def _sharded_attention_fn(kernel, mesh: Mesh, axis: str, kernel_kw: tuple):
+    """Jitted shard_map program per (kernel, mesh, axis, kernel kwargs).
+
+    The cache key is the RAW kernel function plus hashable kwargs — a
+    ``functools.partial`` built by the caller would hash by object identity
+    and never hit, so the partial is applied in here instead. Without this
+    cache every ``sharded_attention`` call constructed (and retraced) a
+    fresh jitted callable — the retrace-risk pattern tiplint now flags.
+    """
     spec = P(None, axis, None, None)
+    kernel_fn = functools.partial(kernel, **dict(kernel_kw)) if kernel_kw else kernel
     fn = jax.shard_map(
         kernel_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
-    sharding = NamedSharding(mesh, spec)
+    return jax.jit(fn), NamedSharding(mesh, spec)
+
+
+def sharded_attention(q, k, v, mesh: Mesh, axis: str, kernel, **kernel_kw):
+    """Shared scaffolding for the sequence-parallel attention wrappers:
+    shard q/k/v over ``axis`` of ``mesh`` and run ``kernel`` (a per-shard
+    collective taking (q, k, v), partially applied with ``kernel_kw``)
+    under shard_map + jit."""
+    fn, sharding = _sharded_attention_fn(
+        kernel, mesh, axis, tuple(sorted(kernel_kw.items()))
+    )
     q = jax.device_put(jnp.asarray(q), sharding)
     k = jax.device_put(jnp.asarray(k), sharding)
     v = jax.device_put(jnp.asarray(v), sharding)
-    return jax.jit(fn)(q, k, v)
+    return fn(q, k, v)
 
 
 def ring_attention_sharded(
@@ -144,12 +161,8 @@ def ring_attention_sharded(
     ``axis`` of ``mesh``. Host-convenience wrapper around shard_map."""
     check_ring_divisibility(q.shape[1], mesh.shape[axis])
     return sharded_attention(
-        q,
-        k,
-        v,
-        mesh,
-        axis,
-        functools.partial(ring_attention, axis_name=axis, n_dev=mesh.shape[axis]),
+        q, k, v, mesh, axis, ring_attention,
+        axis_name=axis, n_dev=mesh.shape[axis],
     )
 
 

@@ -409,10 +409,13 @@ def run_phase_parallel(
             estimate.get("error_s") or 0.0, estimate.get("basis"),
             estimate.get("corpus_rows"), len(pending), num_workers,
         )
+    from simple_tip_tpu.engine.run_program import fused_chain_enabled
+
     phase_span = obs.span(
         "scheduler.phase", phase=phase, case_study=case_study,
         runs=len(model_ids), workers=num_workers,
-        journal_skipped=len(skipped), **predicted,
+        journal_skipped=len(skipped),
+        fused_chain=fused_chain_enabled(), **predicted,
     )
     phase_span.__enter__()
     phase_started = time.perf_counter()

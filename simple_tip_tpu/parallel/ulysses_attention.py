@@ -114,5 +114,5 @@ def ulysses_attention_sharded(
 
     check_ulysses_divisibility(q.shape[1], q.shape[2], mesh.shape[axis])
     return sharded_attention(
-        q, k, v, mesh, axis, functools.partial(ulysses_attention, axis_name=axis)
+        q, k, v, mesh, axis, ulysses_attention, axis_name=axis
     )

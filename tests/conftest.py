@@ -35,6 +35,15 @@ for _var in [
 ]:
     os.environ.pop(_var, None)
 
+# An inherited fused-chain toggle would silently reroute every prio-path
+# test through the AOT program layer (and a developer's program-cache dir
+# would leak compiled executables across suites); the fused path is opted
+# into per-test.
+for _var in ["TIP_FUSED_CHAIN", "TIP_INT8_PROFILES"] + [
+    v for v in os.environ if v.startswith("TIP_PROGRAM_CACHE")
+]:
+    os.environ.pop(_var, None)
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,205 @@
+"""ops/fused_chain parity tests: the whole-chain traced program must be
+bit-identical to the per-phase building blocks it fuses — packing layout vs
+``pack_profiles``, chain outputs vs direct metric/quantifier evaluation,
+padded-row masking, the vmapped group form, the traced rank vs
+``device_cam_greedy``, and the exact int8 codebook (NaN guard included)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from simple_tip_tpu.models.convnet import MnistConvNet
+from simple_tip_tpu.models.train import init_params
+from simple_tip_tpu.ops.coverage import (
+    KMNC,
+    NAC,
+    NBC,
+    SNAC,
+    TKNC,
+    flatten_layers,
+)
+from simple_tip_tpu.ops.fused_chain import (
+    ThresholdCodebook,
+    make_chain_fn,
+    make_group_chain_fn,
+    pack_bits_u32,
+    rank_badges,
+    rank_badges_grouped,
+)
+from simple_tip_tpu.ops.prioritizers import device_cam_greedy, pack_profiles
+from simple_tip_tpu.ops.uncertainty import POINT_PRED_QUANTIFIERS
+
+LAYERS = (0, 1, 2, 3)
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    """Model, params, train/test data and per-phase-built coverage metrics."""
+    rng = np.random.RandomState(0)
+    model = MnistConvNet(num_classes=4)
+    x_train = rng.rand(48, 12, 12, 1).astype(np.float32)
+    x_test = rng.rand(24, 12, 12, 1).astype(np.float32)
+    params = init_params(model, jax.random.PRNGKey(3), x_train[:2])
+
+    def taps_of(x):
+        probs, taps = model.apply({"params": params}, jnp.asarray(x), train=False)
+        return np.asarray(probs), [np.asarray(taps[i]) for i in LAYERS]
+
+    _, train_acts = taps_of(x_train)
+    flat = flatten_layers(train_acts)
+    mins, maxs = [flat.min(axis=0)], [flat.max(axis=0)]
+    stds = [flat.std(axis=0)]
+    metrics = {
+        "NAC_0": NAC(cov_threshold=0.0),
+        "NAC_0.75": NAC(cov_threshold=0.75),
+        "NBC_0.5": NBC(mins=mins, maxs=maxs, stds=stds, scaler=0.5),
+        "SNAC_0": SNAC(maxs=maxs, stds=stds, scaler=0.0),
+        "KMNC_2": KMNC(mins, maxs, sections=2),
+        "TKNC_2": TKNC(top_neurons=2),
+    }
+    return model, params, x_test, metrics, taps_of
+
+
+def test_pack_bits_u32_matches_host_packer():
+    rng = np.random.RandomState(1)
+    packer = jax.jit(pack_bits_u32)
+    for width in (1, 31, 32, 33, 100, 257):
+        flat = rng.rand(7, width) > 0.5
+        dev = np.asarray(packer(jnp.asarray(flat)))
+        np.testing.assert_array_equal(dev, pack_profiles(flat))
+
+
+@pytest.mark.parametrize("int8_profiles", [False, True])
+def test_chain_matches_per_phase_pieces(tiny_setup, int8_profiles):
+    """One traced chain == forward + quantifiers + each metric + packer."""
+    model, params, x_test, metrics, taps_of = tiny_setup
+    chain = jax.jit(
+        make_chain_fn(model, LAYERS, metrics, int8_profiles=int8_profiles)
+    )
+    pred, unc, cov = chain(params, jnp.asarray(x_test), np.int32(len(x_test)))
+
+    probs, acts = taps_of(x_test)
+    np.testing.assert_array_equal(np.asarray(pred), np.argmax(probs, axis=1))
+    for name, fn in POINT_PRED_QUANTIFIERS.items():
+        ref = fn(probs)[1]
+        got = np.asarray(unc[name])
+        # XLA log/mul rounding may differ from host numpy by ULPs; the
+        # consumer contract is the ordering (ops/uncertainty.py docstring)
+        np.testing.assert_allclose(got, ref, rtol=0, atol=1e-6)
+        np.testing.assert_array_equal(
+            np.argsort(-got, kind="stable"), np.argsort(-ref, kind="stable")
+        )
+    for mid, metric in metrics.items():
+        s_ref, p_ref = metric(acts)
+        s, packed = cov[mid]
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(s_ref))
+        np.testing.assert_array_equal(np.asarray(packed), pack_profiles(np.asarray(p_ref)))
+
+
+def test_chain_masks_padding_rows(tiny_setup):
+    """Rows at index >= valid get all-zero packed profiles (unpickable by
+    CAM); valid rows are bit-identical to the unpadded run."""
+    model, params, x_test, metrics, _ = tiny_setup
+    chain = jax.jit(make_chain_fn(model, LAYERS, metrics))
+    n = len(x_test)
+    pad = np.concatenate([x_test, np.zeros((8,) + x_test.shape[1:], x_test.dtype)])
+    _, _, cov_pad = chain(params, jnp.asarray(pad), np.int32(n))
+    _, _, cov_ref = chain(params, jnp.asarray(x_test), np.int32(n))
+    for mid in metrics:
+        packed_pad = np.asarray(cov_pad[mid][1])
+        assert not packed_pad[n:].any(), f"{mid}: padding rows have set bits"
+        np.testing.assert_array_equal(packed_pad[:n], np.asarray(cov_ref[mid][1]))
+
+
+def test_group_chain_matches_per_member(tiny_setup):
+    """The vmapped G-group chain equals running each member separately."""
+    model, params, x_test, metrics, _ = tiny_setup
+    params2 = init_params(model, jax.random.PRNGKey(11), x_test[:2])
+    stacked = jax.tree_util.tree_map(
+        lambda a, b: jnp.stack([a, b]), params, params2
+    )
+    group = jax.jit(make_group_chain_fn(model, LAYERS, metrics))
+    chain = jax.jit(make_chain_fn(model, LAYERS, metrics))
+    xb = jnp.asarray(x_test)
+    g_pred, g_unc, g_cov = group(stacked, xb, np.int32(len(x_test)))
+    for g, p in enumerate((params, params2)):
+        pred, unc, cov = chain(p, xb, np.int32(len(x_test)))
+        np.testing.assert_array_equal(np.asarray(g_pred[g]), np.asarray(pred))
+        for name in unc:
+            np.testing.assert_array_equal(
+                np.asarray(g_unc[name][g]), np.asarray(unc[name])
+            )
+        for mid in metrics:
+            np.testing.assert_array_equal(
+                np.asarray(g_cov[mid][1][g]), np.asarray(cov[mid][1])
+            )
+
+
+def test_rank_badges_matches_device_cam(tiny_setup):
+    """Traced concat+rank == device_cam_greedy over host-concatenated badges,
+    for both the flat and the grouped form."""
+    rng = np.random.RandomState(5)
+    full = pack_profiles(rng.rand(40, 70) > 0.6)
+    badges = (jnp.asarray(full[:20]), jnp.asarray(full[20:]))
+    picked, count = jax.jit(rank_badges)(badges)  # tiplint: disable=retrace-risk (one-shot per-test compile)
+    ref_picked, ref_count = device_cam_greedy(jnp.asarray(full), 40)
+    assert int(count) == int(ref_count)
+    np.testing.assert_array_equal(np.asarray(picked), np.asarray(ref_picked))
+
+    grouped = (
+        jnp.stack([badges[0], badges[0]]),
+        jnp.stack([badges[1], badges[1]]),
+    )
+    g_picked, g_count = jax.jit(rank_badges_grouped)(grouped)  # tiplint: disable=retrace-risk (one-shot per-test compile)
+    for g in range(2):
+        assert int(g_count[g]) == int(ref_count)
+        np.testing.assert_array_equal(np.asarray(g_picked[g]), np.asarray(ref_picked))
+
+
+def test_int8_codebook_exact_on_nan_and_ties():
+    """The int8 interval coding is EXACT: same bits as the plain metrics on
+    activations containing NaN, exact-threshold ties, and +/-inf."""
+    n_neurons = 6
+    mins = [np.array([-1.0, 0.0, 0.5, -2.0, 0.0, 1.0], np.float32)]
+    maxs = [np.array([1.0, 2.0, 0.5, 3.0, 0.0, 4.0], np.float32)]
+    stds = [np.array([0.5, 1.0, 0.0, 0.25, 0.0, 2.0], np.float32)]
+    metrics = {
+        "NAC_0": NAC(cov_threshold=0.0),
+        "NBC_0": NBC(mins=mins, maxs=maxs, stds=stds, scaler=0.0),
+        "NBC_0.5": NBC(mins=mins, maxs=maxs, stds=stds, scaler=0.5),
+        "SNAC_1": SNAC(maxs=maxs, stds=stds, scaler=1.0),
+        "KMNC_2": KMNC(mins, maxs, sections=2),
+    }
+    codebook = ThresholdCodebook(metrics)
+    assert all(codebook.covers(m) for m in metrics)
+
+    rng = np.random.RandomState(9)
+    acts = rng.uniform(-3, 5, size=(32, n_neurons)).astype(np.float32)
+    # exact boundary hits (tie policy), NaN, and infinities
+    acts[0] = mins[0]
+    acts[1] = maxs[0]
+    acts[2, :3] = np.nan
+    acts[3, 0] = np.inf
+    acts[3, 1] = -np.inf
+    acts[4] = 0.0
+
+    coded = jax.jit(lambda a: codebook.apply(a))(jnp.asarray(acts))  # tiplint: disable=retrace-risk (one-shot per-test compile)
+    for mid, metric in metrics.items():
+        s_ref, p_ref = metric([acts])
+        s, p = coded[mid]
+        np.testing.assert_array_equal(
+            np.asarray(p).reshape(np.asarray(p_ref).shape),
+            np.asarray(p_ref),
+            err_msg=f"{mid} profiles diverge from plain metric",
+        )
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(s_ref))
+
+
+def test_int8_codebook_rejects_cut_overflow():
+    """More than 127 cutpoints cannot be coded in int8."""
+    mins = [np.zeros(3, np.float32)]
+    maxs = [np.ones(3, np.float32)]
+    with pytest.raises(ValueError, match="int8"):
+        ThresholdCodebook({"KMNC_200": KMNC(mins, maxs, sections=200)})

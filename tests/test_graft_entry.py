@@ -18,7 +18,7 @@ def _entry_module():
 def test_entry_compiles_and_runs():
     g = _entry_module()
     fn, (params, x) = g.entry()
-    pred, gini, ms = jax.jit(fn)(params, x)
+    pred, gini, ms = jax.jit(fn)(params, x)  # tiplint: disable=retrace-risk (one-shot compile-and-run is the test subject)
     pred, gini, ms = np.asarray(pred), np.asarray(gini), np.asarray(ms)
     assert pred.shape == (x.shape[0],)
     assert gini.shape == ms.shape == (x.shape[0],)

@@ -1,0 +1,425 @@
+"""engine/run_program tests: ProgramCache knob grammar, LRU sweep,
+fingerprint invalidation, degraded loads, cross-process executable reuse,
+fused-vs-per-phase artifact parity (the acceptance pin), and the
+fewer-compiled-dispatches claim asserted via the ``jax.compiles`` counter."""
+
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from simple_tip_tpu import obs
+from simple_tip_tpu.engine import eval_prioritization as ep
+from simple_tip_tpu.engine.run_program import (
+    PROGRAM_FORMAT_VERSION,
+    FusedChainRunner,
+    ProgramCache,
+    fused_chain_enabled,
+    program_cache_max_bytes,
+    program_fingerprint,
+    rank_fingerprint,
+)
+from simple_tip_tpu.models.convnet import Cifar10ConvNet, MnistConvNet
+from simple_tip_tpu.models.train import init_params
+from simple_tip_tpu.ops.coverage import NAC
+
+LAYERS = (0, 1, 2, 3)
+
+
+def _counters():
+    return obs.metrics_snapshot()["counters"]
+
+
+def _tiny_model(num_classes=4, side=12, n_train=48, n_test=24, seed=0):
+    rng = np.random.RandomState(seed)
+    model = MnistConvNet(num_classes=num_classes)
+    x_train = rng.rand(n_train, side, side, 1).astype(np.float32)
+    x_test = rng.rand(n_test, side, side, 1).astype(np.float32)
+    params = init_params(model, jax.random.PRNGKey(seed + 1), x_train[:2])
+    return model, params, x_train, x_test
+
+
+# -- knob grammar -------------------------------------------------------------
+
+
+def test_fused_chain_knob(monkeypatch):
+    for raw, expect in [
+        ("1", True), ("on", True), ("TRUE", True),
+        ("", False), ("0", False), ("off", False), ("no", False),
+    ]:
+        monkeypatch.setenv("TIP_FUSED_CHAIN", raw)
+        assert fused_chain_enabled() is expect, raw
+    monkeypatch.delenv("TIP_FUSED_CHAIN")
+    assert fused_chain_enabled() is False
+
+
+def test_program_cache_max_bytes_knob(monkeypatch):
+    cases = {
+        "": None, "0": None, "off": None, "unlimited": None, "none": None,
+        "4096": 4096, "2k": 2048, "1.5k": 1536, "3m": 3 * 1024**2,
+        "1g": 1024**3, "2K": 2048,
+    }
+    for raw, expect in cases.items():
+        monkeypatch.setenv("TIP_PROGRAM_CACHE_MAX_BYTES", raw)
+        assert program_cache_max_bytes() == expect, raw
+    monkeypatch.setenv("TIP_PROGRAM_CACHE_MAX_BYTES", "lots")
+    with pytest.raises(ValueError, match="TIP_PROGRAM_CACHE_MAX_BYTES"):
+        program_cache_max_bytes()
+
+
+def test_from_env_policy(monkeypatch, tmp_path):
+    monkeypatch.setenv("TIP_PROGRAM_CACHE_DIR", "off")
+    assert ProgramCache.from_env() is None
+    monkeypatch.setenv("TIP_PROGRAM_CACHE_DIR", "0")
+    assert ProgramCache.from_env() is None
+    monkeypatch.setenv("TIP_PROGRAM_CACHE_DIR", str(tmp_path / "explicit"))
+    assert ProgramCache.from_env().root == str(tmp_path / "explicit")
+    monkeypatch.delenv("TIP_PROGRAM_CACHE_DIR")
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "assets"))
+    assert ProgramCache.from_env().root == str(
+        tmp_path / "assets" / "program_cache"
+    )
+
+
+# -- LRU sweep ----------------------------------------------------------------
+
+
+def test_cache_sweep_evicts_lru_until_under_cap(tmp_path, monkeypatch):
+    cache = ProgramCache(str(tmp_path))
+    for i, age in enumerate([50, 40, 30, 20, 10]):
+        p = tmp_path / f"prog_{i:024d}.pkl"
+        p.write_bytes(b"x" * 1000)
+        os.utime(p, (1_000_000 - age, 1_000_000 - age))
+    keep = str(tmp_path / "prog_000000000000000000000004.pkl")
+
+    monkeypatch.setenv("TIP_PROGRAM_CACHE_MAX_BYTES", "2500")
+    cache._sweep(keep=keep)
+    survivors = sorted(f.name for f in tmp_path.glob("*.pkl"))
+    # oldest three evicted, newest two fit the cap
+    assert survivors == [
+        "prog_000000000000000000000003.pkl",
+        "prog_000000000000000000000004.pkl",
+    ]
+
+    # the just-written entry survives even a cap it alone exceeds
+    monkeypatch.setenv("TIP_PROGRAM_CACHE_MAX_BYTES", "500")
+    cache._sweep(keep=keep)
+    assert [f.name for f in tmp_path.glob("*.pkl")] == [
+        "prog_000000000000000000000004.pkl"
+    ]
+
+    # uncapped: nothing evicted
+    monkeypatch.setenv("TIP_PROGRAM_CACHE_MAX_BYTES", "")
+    (tmp_path / "prog_x.pkl").write_bytes(b"y" * 4000)
+    cache._sweep(keep=keep)
+    assert len(list(tmp_path.glob("*.pkl"))) == 2
+
+
+# -- fingerprints -------------------------------------------------------------
+
+
+def test_program_fingerprint_invalidation(monkeypatch):
+    model, params, x_train, _ = _tiny_model()
+    metrics = {"NAC_0": NAC(cov_threshold=0.0)}
+    base = program_fingerprint(
+        model, params, LAYERS, metrics, (16, 12, 12, 1), np.float32, "chain"
+    )
+    assert base == program_fingerprint(
+        model, params, LAYERS, metrics, (16, 12, 12, 1), np.float32, "chain"
+    )
+    variants = [
+        # badge shape / input dtype
+        program_fingerprint(model, params, LAYERS, metrics, (32, 12, 12, 1), np.float32, "chain"),
+        program_fingerprint(model, params, LAYERS, metrics, (16, 12, 12, 1), np.float16, "chain"),
+        # baked metric content
+        program_fingerprint(model, params, LAYERS, {"NAC_0": NAC(cov_threshold=0.5)}, (16, 12, 12, 1), np.float32, "chain"),
+        # module config and tap set
+        program_fingerprint(MnistConvNet(num_classes=7), params, LAYERS, metrics, (16, 12, 12, 1), np.float32, "chain"),
+        program_fingerprint(model, params, (0, 1), metrics, (16, 12, 12, 1), np.float32, "chain"),
+        # tags (chain vs rank vs int8 mode)
+        program_fingerprint(model, params, LAYERS, metrics, (16, 12, 12, 1), np.float32, "chain", "int8=True"),
+    ]
+    # param tree ARCHITECTURE keys it (values are runtime inputs)
+    _, params2, _, _ = _tiny_model(num_classes=6)
+    variants.append(
+        program_fingerprint(model, params2, LAYERS, metrics, (16, 12, 12, 1), np.float32, "chain")
+    )
+    assert len({base, *variants}) == len(variants) + 1
+
+    # serialized executables are backend-specific: a cache written on one
+    # backend must miss on another
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu-fake")
+    assert base != program_fingerprint(
+        model, params, LAYERS, metrics, (16, 12, 12, 1), np.float32, "chain"
+    )
+
+
+def test_rank_fingerprint_shape_keyed(monkeypatch):
+    base = rank_fingerprint(3, 512, 40)
+    assert base == rank_fingerprint(3, 512, 40)
+    assert len({base, rank_fingerprint(4, 512, 40), rank_fingerprint(3, 256, 40), rank_fingerprint(3, 512, 41)}) == 4
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu-fake")
+    assert base != rank_fingerprint(3, 512, 40)
+
+
+# -- load degradation + cross-process reuse -----------------------------------
+
+
+def test_load_miss_stale_corrupt_degrade_to_none(tmp_path):
+    cache = ProgramCache(str(tmp_path))
+    key = "a" * 64
+    before = dict(_counters())
+    assert cache.load(key) is None  # miss
+
+    with open(cache._path(key), "wb") as f:
+        f.write(b"not a pickle at all")
+    assert cache.load(key) is None  # corrupt
+
+    entry = {
+        "meta": {"version": "run-program-v0", "fingerprint": key},
+        "payload": b"",
+        "in_tree": None,
+        "out_tree": None,
+    }
+    with open(cache._path(key), "wb") as f:
+        pickle.dump(entry, f)
+    assert cache.load(key) is None  # stale version
+
+    entry["meta"] = {"version": PROGRAM_FORMAT_VERSION, "fingerprint": "b" * 64}
+    with open(cache._path(key), "wb") as f:
+        pickle.dump(entry, f)
+    assert cache.load(key) is None  # fingerprint collision on truncated name
+
+    after = _counters()
+    assert after.get("program_cache.miss", 0) - before.get("program_cache.miss", 0) == 1
+    assert after.get("program_cache.corrupt", 0) - before.get("program_cache.corrupt", 0) == 1
+    assert after.get("program_cache.stale", 0) - before.get("program_cache.stale", 0) == 2
+
+
+_REUSE_SCRIPT = r"""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from simple_tip_tpu import obs
+from simple_tip_tpu.engine.run_program import ProgramCache, aot_compile
+
+cache = ProgramCache(sys.argv[1])
+jitted = jax.jit(lambda a, b: jnp.tanh(a @ b).sum(axis=1))
+specs = (
+    jax.ShapeDtypeStruct((8, 16), np.dtype(np.float32)),
+    jax.ShapeDtypeStruct((16, 4), np.dtype(np.float32)),
+)
+prog = aot_compile(jitted, specs, cache, "c" * 64, program="chain")
+a = np.ones((8, 16), np.float32)
+b = np.ones((16, 4), np.float32)
+np.testing.assert_allclose(np.asarray(prog(a, b)), np.tanh(a @ b).sum(axis=1), rtol=1e-6)
+c = obs.metrics_snapshot()["counters"]
+print("HIT=%d MISS=%d STORE=%d" % (
+    c.get("program_cache.hit", 0),
+    c.get("program_cache.miss", 0),
+    c.get("program_cache.store", 0),
+))
+"""
+
+
+def test_cross_process_executable_reuse(tmp_path):
+    """A second interpreter deserializes the first one's compiled program
+    (the run_scheduler worker-respawn scenario)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    outs = []
+    for _ in range(2):
+        proc = subprocess.run(
+            [sys.executable, "-c", _REUSE_SCRIPT, str(tmp_path / "cache")],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            cwd="/root/repo",
+            env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        outs.append(proc.stdout.strip().splitlines()[-1])
+    assert outs[0] == "HIT=0 MISS=1 STORE=1"
+    assert outs[1] == "HIT=1 MISS=0 STORE=0"
+
+
+def test_runner_reuses_cached_programs(tmp_path, monkeypatch):
+    """A fresh runner with the same config loads every program from disk."""
+    monkeypatch.setenv("TIP_PROGRAM_CACHE_DIR", str(tmp_path / "pc"))
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "assets"))
+    model, params, x_train, x_test = _tiny_model()
+
+    def run():
+        runner = FusedChainRunner(
+            model, params, x_train, LAYERS, batch_size=16, badge_size=16
+        )
+        return runner.evaluate_dataset(x_test)
+
+    before = dict(_counters())
+    first = run()
+    mid = dict(_counters())
+    assert mid.get("program_cache.store", 0) > before.get("program_cache.store", 0)
+    second = run()
+    after = _counters()
+    assert after.get("program_cache.hit", 0) > mid.get("program_cache.hit", 0)
+    np.testing.assert_array_equal(first["pred"], second["pred"])
+    for mid_ in first["cam_orders"]:
+        np.testing.assert_array_equal(
+            first["cam_orders"][mid_], second["cam_orders"][mid_]
+        )
+
+
+# -- parity + dispatch-count acceptance ---------------------------------------
+
+
+def _collect_artifacts(case_study, model_id, unc_ids, metric_ids):
+    out = {}
+    for ds in ("nominal", "ood"):
+        out[ds, "is_misclassified"] = ep.load(case_study, ds, "is_misclassified", model_id)
+        for uid in unc_ids:
+            out[ds, f"uncertainty_{uid}"] = ep.load(case_study, ds, f"uncertainty_{uid}", model_id)
+        for mid in metric_ids:
+            out[ds, f"{mid}_scores"] = ep.load(case_study, ds, f"{mid}_scores", model_id)
+            out[ds, f"{mid}_cam_order"] = ep.load(case_study, ds, f"{mid}_cam_order", model_id)
+    return out
+
+
+@pytest.mark.parametrize(
+    "case_study,num_classes,side",
+    [
+        ("tiny_synthetic", 4, 16),
+        # the real MNIST/FMNIST architecture at its real 28x28x1 input
+        # geometry (full conv tap set) — seeded inputs, untrained params
+        ("mnist_arch", 10, 28),
+    ],
+)
+def test_fused_artifacts_match_per_phase(tmp_path, monkeypatch, case_study, num_classes, side):
+    """THE acceptance pin: the fused path persists the identical artifact set
+    — ranks/scores/pred byte-identical; uncertainty values within float ULPs
+    with identical ordering (ops/uncertainty.py consumer contract)."""
+    model, params, x_train, x_nom = _tiny_model(
+        num_classes=num_classes, side=side, n_train=64, n_test=40, seed=3
+    )
+    rng = np.random.RandomState(17)
+    x_ood = rng.rand(24, side, side, 1).astype(np.float32)
+    y_nom = rng.randint(0, num_classes, size=40)
+    y_ood = rng.randint(0, num_classes, size=24)
+    model_id = 0
+
+    def eval_per_phase():
+        for ds, labels, ds_type in ((x_nom, y_nom, "nominal"), (x_ood, y_ood, "ood")):
+            ep._eval_fault_predictors(
+                case_study, model, params, model_id, ds, labels, ds_type, 32
+            )
+        ep._eval_neuron_coverage(
+            case_study, model, params, model_id, LAYERS, x_nom, x_ood, x_train, 32
+        )
+
+    def eval_fused():
+        ep._eval_fused_chain(
+            case_study, model, params, model_id, LAYERS,
+            x_nom, y_nom, x_ood, y_ood, x_train, 32,
+        )
+
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "per_phase"))
+    eval_per_phase()
+    from simple_tip_tpu.engine.coverage_handler import CoverageWorker
+    from simple_tip_tpu.engine.model_handler import BaseModel
+
+    metric_ids = list(
+        CoverageWorker(
+            base_model=BaseModel(model, params, activation_layers=LAYERS, batch_size=32),
+            training_set=x_train,
+        ).metrics
+    )
+    unc_ids = ["softmax", "pcs", "softmax_entropy", "deep_gini", "VR"]
+    ref = _collect_artifacts(case_study, model_id, unc_ids, metric_ids)
+
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "fused"))
+    eval_fused()
+    got = _collect_artifacts(case_study, model_id, unc_ids, metric_ids)
+
+    assert set(ref) == set(got)
+    for key in ref:
+        if key[1].startswith("uncertainty_"):
+            np.testing.assert_allclose(
+                got[key], ref[key], rtol=0, atol=1e-6, err_msg=str(key)
+            )
+            np.testing.assert_array_equal(
+                np.argsort(-got[key], kind="stable"),
+                np.argsort(-ref[key], kind="stable"),
+                err_msg=f"{key}: uncertainty ORDERING must be identical",
+            )
+        else:
+            np.testing.assert_array_equal(got[key], ref[key], err_msg=str(key))
+
+
+def test_fused_path_compiles_fewer_programs(tmp_path, monkeypatch):
+    """The perf claim the whole PR rides on, in counter form: the fused walk
+    reaches XLA's backend_compile strictly fewer times than the per-phase
+    walk over the same data. Uses a dropout-free model (VR's stochastic pass
+    is orthogonal) and a FRESH persistent-compile-cache dir plus a distinct
+    model config per measurement so neither side gets warm-start credit."""
+    from simple_tip_tpu.engine.coverage_handler import CoverageWorker
+    from simple_tip_tpu.engine.model_handler import BaseModel
+
+    obs.install_jax_hooks()
+    rng = np.random.RandomState(0)
+    # 20x20 is the smallest side that survives Cifar10ConvNet's third
+    # VALID conv (20 -> 18 -> 9 -> 7 -> 3 -> 1)
+    x_train = rng.rand(64, 20, 20, 3).astype(np.float32)
+    x_test = rng.rand(96, 20, 20, 3).astype(np.float32)
+
+    def measure(num_classes, body):
+        # distinct num_classes per measurement defeats the lru_cached
+        # predict/taps closures warmed by earlier tests
+        model = Cifar10ConvNet(num_classes=num_classes)
+        params = init_params(model, jax.random.PRNGKey(num_classes), x_train[:2])
+        jax.config.update(
+            "jax_compilation_cache_dir", str(tmp_path / f"jaxcache{num_classes}")
+        )
+        before = _counters().get("jax.compiles", 0)
+        body(model, params)
+        return _counters().get("jax.compiles", 0) - before
+
+    def per_phase(model, params):
+        base = BaseModel(model, params, activation_layers=None, batch_size=32)
+        base.get_pred_and_uncertainty(x_test)
+        worker = CoverageWorker(
+            base_model=BaseModel(model, params, activation_layers=LAYERS, batch_size=32),
+            training_set=x_train,
+        )
+        worker.evaluate_all(x_test, "nominal")
+
+    def fused(model, params):
+        runner = FusedChainRunner(
+            model, params, x_train, LAYERS, batch_size=32, badge_size=64, cache=None
+        )
+        runner.evaluate_dataset(x_test)
+
+    prev_cache_dir = jax.config.jax_compilation_cache_dir
+    try:
+        per_phase_compiles = measure(3, per_phase)
+        fused_compiles = measure(5, fused)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_cache_dir)
+
+    assert fused_compiles > 0  # the hook is live and the measurement is real
+    assert fused_compiles < per_phase_compiles, (
+        f"fused path compiled {fused_compiles} programs, per-phase "
+        f"{per_phase_compiles}: the fused chain must dispatch fewer"
+    )
+
+    # and the dispatch shape is as designed: 96 inputs at badge_size=64 ->
+    # 2 chain dispatches of ONE compiled program; one rank dispatch per
+    # configured metric (12)
+    c = _counters()
+    assert c.get("run_program.chain_dispatches", 0) >= 2
+    assert c.get("run_program.rank_dispatches", 0) >= 12

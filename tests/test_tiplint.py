@@ -823,8 +823,76 @@ def copy_tree(os_module, src, dst):
 ''',
 }
 
+BAD_RETRACE_RISK = {
+    "mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+
+
+def score(x):
+    """d."""
+    return jnp.sum(x)
+
+
+def per_badge(badges):
+    """A fresh jitted callable per badge retraces every iteration."""
+    out = []
+    for b in badges:
+        fn = jax.jit(score)
+        out.append(fn(b))
+    return out
+
+
+def one_shot(x):
+    """Construct-and-call discards the compiled program immediately."""
+    return jax.jit(score)(x)
+'''
+}
+
+GOOD_RETRACE_RISK = {
+    "mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+
+_scorer = jax.jit(jnp.sum)
+
+
+def per_badge(badges):
+    """A hoisted jitted callable reuses one compile cache per shape."""
+    return [_scorer(b) for b in badges]
+
+
+def combinators(xs, params):
+    """vmap/grad inline are trace-time combinators, not cached callables;
+    a jit inside a traced function inlines into the enclosing trace."""
+    batched = jax.vmap(lambda x: x * 2)(xs)
+
+    @jax.jit
+    def step(p):
+        """d."""
+        inner = jax.jit(lambda q: q + 1)
+        return inner(p)
+
+    return batched, step(params)
+
+
+def decorated_in_loop(badges):
+    """A def (even in a loop) is construction the rule leaves alone."""
+    outs = []
+    for b in badges:
+        @jax.jit
+        def fn(x):
+            """d."""
+            return x + 1
+
+        outs.append(fn(b))
+    return outs
+'''
+}
+
 FIXTURES = {
     "jit-purity": (BAD_JIT_PURITY, GOOD_JIT_PURITY),
+    "retrace-risk": (BAD_RETRACE_RISK, GOOD_RETRACE_RISK),
     "naked-retry": (BAD_NAKED_RETRY, GOOD_NAKED_RETRY),
     "bare-print": (BAD_BARE_PRINT, GOOD_BARE_PRINT),
     "wallclock-duration": (BAD_WALLCLOCK, GOOD_WALLCLOCK),

@@ -85,7 +85,7 @@ def test_ulysses_flash_local_core_matches_dense():
         )
         sharding = NamedSharding(mesh, spec)
         args = [jax.device_put(jnp.asarray(x), sharding) for x in (q, k, v)]
-        return np.asarray(jax.jit(fn)(*args))
+        return np.asarray(jax.jit(fn)(*args))  # tiplint: disable=retrace-risk (one-shot sharded-vs-dense check; compiled once per test)
 
     np.testing.assert_allclose(
         run("flash"), run("dense"), rtol=1e-5, atol=1e-6
@@ -116,7 +116,7 @@ def test_imdb_transformer_ulysses_matches_dense_core():
     params = init_params(model_ref, jax.random.PRNGKey(0), x[:1])
 
     probs_ref, _ = model_ref.apply({"params": params}, x, train=False)
-    probs_uly, _ = jax.jit(
+    probs_uly, _ = jax.jit(  # tiplint: disable=retrace-risk (one-shot parity check; compiled once per test)
         lambda p, xx: model_uly.apply({"params": p}, xx, train=False)
     )(params, x)
     np.testing.assert_allclose(
