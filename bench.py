@@ -355,6 +355,89 @@ def _child_measure() -> None:
         except Exception as e:  # noqa: BLE001 — record, never fail the bench
             fused_chain_info = {"error": repr(e)[:300]}
 
+    # Online-serving companion: drive the scoring engine (serving/ —
+    # continuous batcher over the warm fused-chain program pool) with the
+    # open-loop load generator at three synthetic arrival rates scaled off
+    # a measured warm-badge capacity probe: 0.5x (headroom — latency should
+    # sit near one flush deadline), 1.0x (saturation — badge fill-ratio is
+    # the number that matters) and 2.0x (overload — shed counts are the
+    # measurement, not a failure). The schema-versioned record feeds
+    # obs/store.py feature rows so ``obs trend`` gates serving regressions
+    # alongside the batch phases. TIP_BENCH_SERVING=0 skips; failures
+    # record an error, never take the bench down.
+    serving_info = None
+    if os.environ.get("TIP_BENCH_SERVING", "1").strip().lower() not in (
+        "0",
+        "off",
+    ):
+        try:
+            import asyncio
+
+            from simple_tip_tpu.serving import ScoringEngine, ServingKnobs
+            from simple_tip_tpu.serving.executor import FusedChainExecutor
+            from simple_tip_tpu.serving.loadgen import drive
+
+            sv_rng = np.random.default_rng(7)
+            sv_badge = 128 if on_cpu else 2048
+            sv_train = sv_rng.normal(size=(256, 28, 28, 1)).astype(np.float32)
+            sv_executor = FusedChainExecutor(cache=None)  # price the compile
+            sv_executor.register_model(
+                "bench",
+                sv_badge,
+                model_def=model,
+                params=params,
+                training_set=sv_train,
+                nc_layers=model.nc_layers,
+                batch_size=sv_badge,
+            )
+            # Warm-badge capacity probe: registration already compiled, so
+            # two dispatches give a steady-state per-badge time.
+            sv_probe = sv_rng.normal(size=(sv_badge, 28, 28, 1)).astype(
+                np.float32
+            )
+            sv_executor.run_badge("bench", [sv_probe])
+            t0 = time.perf_counter()
+            sv_executor.run_badge("bench", [sv_probe])
+            sv_badge_s = max(time.perf_counter() - t0, 1e-6)
+            sv_capacity = sv_badge / sv_badge_s
+            sv_knobs = ServingKnobs(
+                max_badge=sv_badge,
+                flush_deadline_s=max(0.005, sv_badge_s),
+            )
+            sv_rows = max(sv_badge // 4, 1)
+            sv_n = 16
+            sv_blocks = [
+                sv_rng.normal(size=(sv_rows, 28, 28, 1)).astype(np.float32)
+                for _ in range(sv_n)
+            ]
+
+            async def _serve_rates():
+                """One engine lifetime per rate (clean queue between rates)."""
+                rates = {}
+                for label, mult in (("0.5x", 0.5), ("1.0x", 1.0), ("2.0x", 2.0)):
+                    async with ScoringEngine(sv_executor, knobs=sv_knobs) as eng:
+                        eng.register_model("bench")  # warm: no recompile
+                        rates[label] = await drive(
+                            eng,
+                            "bench",
+                            lambda i: sv_blocks[i],
+                            n_requests=sv_n,
+                            rows_per_request=sv_rows,
+                            arrival_rows_per_s=sv_capacity * mult,
+                        )
+                return rates
+
+            serving_info = {
+                "schema": 1,
+                "badge_size": sv_badge,
+                "capacity_inputs_per_s": round(sv_capacity, 1),
+                "badge_seconds": round(sv_badge_s, 6),
+                "knobs": sv_knobs.snapshot(),
+                "rates": asyncio.run(_serve_rates()),
+            }
+        except Exception as e:  # noqa: BLE001 — record, never fail the bench
+            serving_info = {"error": repr(e)[:300]}
+
     # Telemetry-overhead companion: seconds per 1000 span enter/exit cycles
     # in the CURRENT obs state (normally disabled — the no-op path the
     # pipeline pays everywhere when TIP_OBS_DIR is unset). The trajectory
@@ -409,6 +492,7 @@ def _child_measure() -> None:
                     if fused_chain_info is not None
                     else {}
                 ),
+                **({"serving": serving_info} if serving_info is not None else {}),
                 "degraded": bool(on_cpu),
                 **(
                     {"degraded_reason": degradation_reason()}

@@ -8,6 +8,7 @@ is: create the module, decorate the class, import it here.
 from simple_tip_tpu.analysis.rules import (  # noqa: F401
     artifact_contract,
     bare_print,
+    blocking_async,
     buffer_donation,
     docstring_coverage,
     f64_on_tpu,

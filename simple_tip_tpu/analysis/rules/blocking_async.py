@@ -1,0 +1,94 @@
+"""Rule ``blocking-in-async``: blocking calls inside ``async def`` bodies.
+
+The serving engine runs one asyncio scheduler loop for EVERY tenant's
+requests: a single blocking call inside a coroutine stalls the whole
+request plane for its duration — batch assembly stops, flush deadlines
+blow, and the p99 the SLO gate watches spikes with no counter explaining
+why. The repo idiom is to keep blocking work in named sync methods and
+run them via ``loop.run_in_executor`` (serving/engine.py's
+``_run_badge_sync`` is the template).
+
+Flagged lexically inside an ``async def`` body (nested sync ``def``s and
+lambdas are skipped — their bodies execute elsewhere, usually exactly in
+that executor thread):
+
+- ``time.sleep(...)`` (module-alias and ``from time import sleep`` forms)
+  — use ``await asyncio.sleep``;
+- blocking ``<future>.result(...)`` — await the future (or wrap it with
+  ``asyncio.wrap_future``);
+- sync file IO via builtin ``open(...)`` — move it to a sync helper run
+  off-loop.
+
+Exempt (same surface logic as ``bare-print``): the ``scripts/`` and
+``tests/`` trees, entry-point modules, and test modules — a smoke script
+blocking its private loop harms nobody.
+"""
+
+import ast
+from typing import Iterator, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.bare_print import _exempt
+from simple_tip_tpu.analysis.rules.naked_retry import _is_time_call, _time_aliases
+
+_NESTED_SCOPES = (ast.FunctionDef, ast.Lambda)
+
+
+def _async_body_nodes(fn: ast.AsyncFunctionDef):
+    """Nodes lexically in ``fn``'s body, not descending into nested sync
+    scopes (their code runs elsewhere) or nested async defs (they are
+    visited as their own roots by the caller's walk)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _NESTED_SCOPES + (ast.AsyncFunctionDef,)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class BlockingInAsyncRule(Rule):
+    """Flag time.sleep / blocking .result() / open() in async bodies."""
+
+    name = "blocking-in-async"
+    description = (
+        "blocking call (time.sleep / Future.result() / open()) inside an "
+        "async def stalls the whole event loop; await the async form or "
+        "run it via loop.run_in_executor (scripts/tests exempt)"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Flag blocking calls lexically inside async function bodies."""
+        if _exempt(module):
+            return
+        mod_aliases, fn_aliases = _time_aliases(module.tree)
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in _async_body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _is_time_call(node, "sleep", mod_aliases, fn_aliases):
+                    yield "", node.lineno, (
+                        f"time.sleep() inside async def {fn.name!r} blocks "
+                        "the event loop (and every other tenant's badges); "
+                        "use `await asyncio.sleep(...)`"
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "result"
+                ):
+                    yield "", node.lineno, (
+                        f".result() inside async def {fn.name!r} blocks the "
+                        "event loop waiting on a future; await it (or "
+                        "asyncio.wrap_future it) instead"
+                    )
+                elif (
+                    isinstance(node.func, ast.Name) and node.func.id == "open"
+                ):
+                    yield "", node.lineno, (
+                        f"sync file IO (open()) inside async def {fn.name!r} "
+                        "blocks the event loop; do the IO in a sync helper "
+                        "via loop.run_in_executor"
+                    )

@@ -136,6 +136,20 @@ def program_fingerprint(
     return h.hexdigest()
 
 
+def select_fingerprint(n: int, k: int, *tags) -> str:
+    """Fingerprint of one AL top-k select program — pure shape-keyed."""
+    import jax
+
+    h = hashlib.sha256()
+    h.update(PROGRAM_FORMAT_VERSION.encode())
+    h.update(f"select:{n}topk{k}".encode())
+    h.update(jax.default_backend().encode())
+    h.update(jax.__version__.encode())
+    for tag in tags:
+        h.update(str(tag).encode())
+    return h.hexdigest()
+
+
 def rank_fingerprint(num_badges: int, badge: int, words: int, *tags) -> str:
     """Fingerprint of one rank (greedy CAM) program — pure shape-keyed."""
     import jax
@@ -410,6 +424,7 @@ class FusedChainRunner:
         self._rank_jit = jax.jit(rank_badges, donate_argnums=_donate(0))
         self._chain_compiled = {}  # (shape, dtype) -> executable
         self._rank_compiled = {}  # (num_badges, words) -> executable
+        self._select_compiled = {}  # (n, k) -> executable
 
     # -- program resolution --------------------------------------------------
 
@@ -444,6 +459,17 @@ class FusedChainRunner:
             self._chain_compiled[key] = prog
         return prog
 
+    def chain_program(self, x_shape, x_dtype):
+        """The AOT chain executable for one badge shape (public warm-pool
+        entry: the serving executor resolves programs at model-register
+        time through this, so a request never pays a compile)."""
+        return self._chain_program(x_shape, x_dtype)
+
+    def select_program(self, n: int, k: int):
+        """The AOT AL top-k select executable over an [n]-vector (public
+        counterpart of ``chain_program`` for the select step)."""
+        return self._select_program(n, k)
+
     def _rank_program(self, num_badges: int, words: int):
         import jax
 
@@ -461,9 +487,53 @@ class FusedChainRunner:
             self._rank_compiled[key] = prog
         return prog
 
+    def _select_program(self, n: int, k: int):
+        import jax
+
+        from simple_tip_tpu.ops.fused_chain import make_select_fn
+
+        key = (int(n), int(k))
+        prog = self._select_compiled.get(key)
+        if prog is None:
+            fp = select_fingerprint(n, k)
+            spec = (
+                jax.ShapeDtypeStruct((int(n),), np.dtype(np.float32)),
+                jax.ShapeDtypeStruct((), np.dtype(np.int32)),
+            )
+            prog = aot_compile(
+                jax.jit(make_select_fn(int(k))),
+                spec,
+                self.cache,
+                fp,
+                program="select",
+            )
+            self._select_compiled[key] = prog
+        return prog
+
+    def select_top_k(self, values: np.ndarray, k: int) -> np.ndarray:
+        """AL top-k select of one host [n] score vector via the AOT select
+        program (padded to the badge-aligned shape so repeated selects of
+        ragged dataset sizes share one executable).
+
+        Returns the selected indices ascending by value, best-last —
+        byte-identical to ``np.argsort(values, kind="stable")[-k:]``, the
+        semantics ``eval_active_learning`` applies on host.
+        """
+        values = np.asarray(values, np.float32)
+        n = values.shape[0]
+        if not 0 < k <= n:
+            raise ValueError(f"select_top_k: k={k} outside 1..{n}")
+        padded_n = -(-n // self.badge_size) * self.badge_size
+        if padded_n > n:
+            values = np.concatenate([values, np.zeros(padded_n - n, np.float32)])
+        prog = self._select_program(padded_n, k)
+        picked = prog(values, np.int32(n))
+        obs.counter("run_program.select_dispatches").inc()
+        return np.asarray(picked).astype(np.int64)
+
     # -- evaluation ----------------------------------------------------------
 
-    def evaluate_dataset(self, x: np.ndarray, rng=None) -> Dict:
+    def evaluate_dataset(self, x: np.ndarray, rng=None, select_k=None) -> Dict:
         """Fused prio evaluation of one test set.
 
         Returns a dict with ``pred`` (host [n]), ``uncertainties`` /
@@ -472,6 +542,9 @@ class FusedChainRunner:
         per-phase ``_eval_fault_predictors`` + ``CoverageWorker`` pair
         produces, from 1 chain dispatch per badge + 1 rank dispatch per
         metric instead of one program per (phase, metric, badge shape).
+        ``select_k`` additionally folds the AL top-k pick into the program
+        pipeline: the result gains ``al_select`` ({quantifier: indices of
+        the k most uncertain inputs, ascending by value, best-last}).
         """
         from simple_tip_tpu.ops.prioritizers import _with_score_tail
 
@@ -538,6 +611,15 @@ class FusedChainRunner:
             self._sanity_check(order, scores[mid])
         if rng is not None and getattr(self.model_def, "has_dropout", False):
             self._add_variation_ratio(x, rng, uncertainties, unc_times)
+        al_select = None
+        if select_k:
+            # the AL-select fold (ROADMAP raw-speed (b) remainder): the
+            # top-k pick every quantifier's AL loop would do on host runs
+            # as one more cached AOT program per (padded n, k)
+            al_select = {
+                name: self.select_top_k(u, int(select_k))
+                for name, u in uncertainties.items()
+            }
         return {
             "pred": pred,
             "uncertainties": uncertainties,
@@ -545,6 +627,7 @@ class FusedChainRunner:
             "scores": scores,
             "cam_orders": cam_orders,
             "cov_times": cov_times,
+            **({"al_select": al_select} if al_select is not None else {}),
         }
 
     def _add_variation_ratio(self, x, rng, uncertainties, unc_times):

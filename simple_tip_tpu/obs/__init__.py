@@ -43,6 +43,7 @@ from simple_tip_tpu.obs.metrics import (
     histogram,
     install_jax_hooks,
     poll_device_memory,
+    quantile,
     record_device_memory,
     snapshot as metrics_snapshot,
     flush as flush_metrics,
@@ -70,6 +71,7 @@ __all__ = [
     "metrics_snapshot",
     "obs_dir",
     "poll_device_memory",
+    "quantile",
     "record_device_memory",
     "record_span",
     "reset",

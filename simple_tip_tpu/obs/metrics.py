@@ -32,6 +32,7 @@ _lock = threading.RLock()
 _counters = {}
 _gauges = {}
 _hists = {}
+_quantiles = {}
 _jax_hooks_installed = False
 # json.dumps of the last snapshot this process flushed into the stream:
 # periodic pollers (the scheduler's device-memory poll) call flush() on a
@@ -99,6 +100,56 @@ class Histogram:
         return self
 
 
+class Quantile:
+    """Sliding-window percentile estimator (SLO p50/p95/p99).
+
+    A bounded ring of the last ``cap`` observations — deterministic (no
+    reservoir randomness), O(cap) memory by construction, and windowed the
+    way SLO dashboards read latency: recent behavior, not the process's
+    whole lifetime. ``percentile`` uses the nearest-rank definition, so
+    p50 of [1, 2, 3] is 2, never an interpolated value no request actually
+    saw.
+    """
+
+    __slots__ = ("count", "cap", "_ring", "_idx")
+
+    def __init__(self, cap: int = 512):
+        self.count = 0
+        self.cap = max(1, int(cap))
+        self._ring = []
+        self._idx = 0
+
+    def observe(self, v):
+        """Record one observation into the window."""
+        v = float(v)
+        with _lock:
+            self.count += 1
+            if len(self._ring) < self.cap:
+                self._ring.append(v)
+            else:
+                self._ring[self._idx] = v
+                self._idx = (self._idx + 1) % self.cap
+        return self
+
+    def percentile(self, q: float):
+        """Nearest-rank percentile ``q`` (0..100) of the window, or None."""
+        with _lock:
+            window = sorted(self._ring)
+        if not window:
+            return None
+        rank = max(1, -(-int(q) * len(window) // 100))  # ceil(q*n/100)
+        return window[min(rank, len(window)) - 1]
+
+    def summary(self) -> dict:
+        """JSON-safe p50/p95/p99 + total observation count."""
+        return {
+            "count": self.count,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+        }
+
+
 def counter(name: str) -> Counter:
     """Get-or-create the counter ``name``."""
     with _lock:
@@ -126,10 +177,24 @@ def histogram(name: str) -> Histogram:
         return h
 
 
-def snapshot() -> dict:
-    """Point-in-time registry state as plain JSON-safe dicts."""
+def quantile(name: str, cap: int = 512) -> Quantile:
+    """Get-or-create the sliding-window quantile ``name``."""
     with _lock:
-        return {
+        q = _quantiles.get(name)
+        if q is None:
+            q = _quantiles[name] = Quantile(cap=cap)
+        return q
+
+
+def snapshot() -> dict:
+    """Point-in-time registry state as plain JSON-safe dicts.
+
+    The ``quantiles`` key is additive next to the original three — the
+    metrics event schema (obs/cli.py REQUIRED_KEYS) only pins presence of
+    counters/gauges/histograms, so older readers keep parsing.
+    """
+    with _lock:
+        snap = {
             "counters": {k: c.value for k, c in sorted(_counters.items())},
             "gauges": {k: g.value for k, g in sorted(_gauges.items())},
             "histograms": {
@@ -137,6 +202,10 @@ def snapshot() -> dict:
                 for k, h in sorted(_hists.items())
             },
         }
+        quantiles = list(sorted(_quantiles.items()))
+    if quantiles:
+        snap["quantiles"] = {k: q.summary() for k, q in quantiles}
+    return snap
 
 
 def flush() -> None:
@@ -153,7 +222,12 @@ def flush() -> None:
     if not tracer.enabled():
         return
     snap = snapshot()
-    if not (snap["counters"] or snap["gauges"] or snap["histograms"]):
+    if not (
+        snap["counters"]
+        or snap["gauges"]
+        or snap["histograms"]
+        or snap.get("quantiles")
+    ):
         return
     import json
 
@@ -176,6 +250,7 @@ def reset() -> None:
         _counters.clear()
         _gauges.clear()
         _hists.clear()
+        _quantiles.clear()
         _jax_hooks_installed = False
         _last_flushed = None
 

@@ -117,6 +117,12 @@ def _normalize_bench(doc: dict, source: str) -> dict:
             snap["phases"][f"sa_fit.{variant}"] = float(secs)
     if isinstance(sa.get("total"), (int, float)):
         snap["phases"]["sa_fit.total"] = float(sa["total"])
+    # Serving companion: p99 per arrival rate becomes a gated phase so a
+    # latency regression on the online path fails `obs trend` exactly like
+    # a batch-phase slowdown.
+    for label, rate in ((doc.get("serving") or {}).get("rates") or {}).items():
+        if isinstance(rate, dict) and isinstance(rate.get("p99_ms"), (int, float)):
+            snap["phases"][f"serving.p99.{label}"] = float(rate["p99_ms"]) / 1000.0
     return snap
 
 

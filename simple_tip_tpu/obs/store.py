@@ -312,6 +312,27 @@ def _rows_from_bench(path: str, seq: int) -> list:
         row["phase"] = "obs.overhead_per_1k_spans"
         row["seconds"] = float(doc["obs_overhead_seconds"])
         rows.append(row)
+    # Serving companion (schema 1): per-arrival-rate SLO features so the
+    # learned cost model and the trend gate see the online path.
+    serving = doc.get("serving") or {}
+    for label, rate in sorted((serving.get("rates") or {}).items()):
+        if not isinstance(rate, dict):
+            continue
+        for field, phase, as_seconds in (
+            ("p99_ms", "p99", True),  # ms -> seconds, like every phase row
+            ("sustained_inputs_per_s", "sustained_inputs_per_s", False),
+            ("badge_fill", "badge_fill", False),
+        ):
+            v = rate.get(field)
+            if not isinstance(v, (int, float)):
+                continue
+            row = base()
+            row["phase"] = f"serving.{phase}.{label}"
+            if as_seconds:
+                row["seconds"] = float(v) / 1000.0
+            else:
+                row["value"] = float(v)
+            rows.append(row)
     return rows
 
 

@@ -251,6 +251,41 @@ def make_group_chain_fn(
     return jax.vmap(chain, in_axes=(0, None, None))
 
 
+def select_top_k(values, valid, k: int):
+    """Traced AL top-k select: indices of the ``k`` largest valid values.
+
+    The fused-chain counterpart of ``eval_active_learning``'s
+    ``np.argsort(uncertainty)[-num_selected:]`` — the last host-side numpy
+    step of the select loop, folded onto the device so the AL selection
+    can ride the same AOT program pipeline as scoring (ROADMAP raw-speed
+    item (b), the open remainder). ``values`` is a traced [N] vector,
+    ``valid`` a traced int32 scalar masking badge padding (rows at index
+    >= valid sort to the bottom and can never be selected while k <=
+    valid), and ``k`` is STATIC (it shapes the output).
+
+    Tie policy is pinned to jax's STABLE ascending argsort: among equal
+    values the higher index wins a contested last slot — byte-identical to
+    ``np.argsort(values, kind="stable")[-k:]``, which the parity tests
+    assert. Output layout matches the numpy idiom: ascending by value,
+    best-last.
+    """
+    import jax.numpy as jnp
+
+    idx = jnp.arange(values.shape[0])
+    masked = jnp.where(idx < valid, values.astype(jnp.float32), -jnp.inf)
+    return jnp.argsort(masked)[-int(k):]
+
+
+def make_select_fn(k: int):
+    """``(values, valid) -> top-k indices`` with ``k`` closed over, in the
+    AOT-lowerable shape ``engine/run_program.py`` compiles and caches."""
+
+    def select(values, valid):
+        return select_top_k(values, valid, k)
+
+    return select
+
+
 def rank_badges(badges):
     """Greedy CAM picks over a tuple of equally-shaped packed badges.
 

@@ -27,9 +27,18 @@ _LAZY_EXPORTS = {
     "stack_init": "ensemble",
     "train_ensemble": "ensemble",
     "unstack": "ensemble",
+    "LoopThread": "aio",
+    "shared_loop": "aio",
 }
 
-__all__ = ["train_ensemble", "stack_init", "unstack", "ensemble_mesh"]
+__all__ = [
+    "LoopThread",
+    "ensemble_mesh",
+    "shared_loop",
+    "stack_init",
+    "train_ensemble",
+    "unstack",
+]
 
 
 def __getattr__(name):

@@ -1,0 +1,94 @@
+"""Online TIP scoring service: continuous batching over cached AOT programs.
+
+The flagship scoring path sustains millions of inputs/s/chip — but only as
+an offline study phase where ``eval_prioritization`` owns the badge walk.
+This package is the request engine the ROADMAP's "millions of users" item
+asks for: an asyncio scoring service that keeps the chip fed from an
+asynchronous request stream (the Podracer architecture, PAPERS.md arXiv
+2104.06272) while reusing every piece of substrate the batch path already
+trusts:
+
+- **continuous batcher** (``batcher``): in-flight requests coalesce into
+  the ONE padded badge shape the ProgramCache compiled for; partial badges
+  ride the chain program's traced ``valid`` masking, and a max-latency
+  flush deadline bounds how long a lonely request waits for co-riders;
+- **program-warm pool** (``executor``): per-(case-study, model-id) AOT
+  executables resolved through ``ProgramCache`` fingerprints at model-
+  REGISTER time (compile cost lands in the register span, never in a
+  request), with donated input ring buffers (SNIPPETS.md [3]'s
+  compile_step donate_argnums pattern);
+- **multi-tenant routing** (``engine``): per-model queues with fair
+  round-robin badge scheduling, so one chatty tenant cannot starve the
+  rest;
+- **admission control + graceful shedding** (``admission``): ``obs
+  predict``'s learned cost model bounds queue depth in predicted seconds,
+  the resilience CircuitBreaker fronts backend loss, and overload returns
+  explicit 429-style ``RequestShed`` rejections instead of unbounded
+  queuing;
+- **SLO telemetry**: p50/p95/p99 request latency (``obs.quantile``),
+  badge fill-ratio, queue depth and shed counts flow through
+  ``obs/metrics.py``; the bench ``serving`` companion lands them in the
+  feature store so ``obs trend`` gates serving regressions like batch ones.
+
+The core (knobs, batcher, admission, engine, ``StubExecutor``) is
+stdlib-only and importable without jax or numpy — the dependency-free CI
+smoke drives the full batching/admission/shed path with a stub backend.
+``FusedChainExecutor`` is the real backend and imports jax lazily at
+model-register time. Correctness rests on the chain program's row
+independence (pinned by ``test_chain_masks_padding_rows``): a row's
+outputs do not depend on which badge it rode in, so online coalescing is
+byte-identical to the offline ``FusedChainRunner`` walk — CI-enforced by
+``scripts/serving_smoke.py``.
+
+Env knobs: ``TIP_SERVE_MAX_BADGE``, ``TIP_SERVE_FLUSH_DEADLINE_MS``,
+``TIP_SERVE_QUEUE_BOUND``, ``TIP_SERVE_SHED_MODE``, ``TIP_SERVE_INFLIGHT``,
+``TIP_SERVE_MAX_BACKLOG_S`` (see ``knobs``; README "Online serving").
+"""
+
+from simple_tip_tpu.serving.admission import AdmissionController
+from simple_tip_tpu.serving.batcher import Chunk, ContinuousBatcher
+from simple_tip_tpu.serving.engine import ScoringEngine
+from simple_tip_tpu.serving.errors import (
+    BackendDown,
+    EngineClosed,
+    RequestShed,
+    ServingError,
+)
+from simple_tip_tpu.serving.executor import StubExecutor
+from simple_tip_tpu.serving.knobs import ServingKnobs
+
+_LAZY_EXPORTS = {
+    "FusedChainExecutor": "executor",
+    "drive": "loadgen",
+}
+
+__all__ = [
+    "AdmissionController",
+    "BackendDown",
+    "Chunk",
+    "ContinuousBatcher",
+    "EngineClosed",
+    "FusedChainExecutor",
+    "RequestShed",
+    "ScoringEngine",
+    "ServingError",
+    "ServingKnobs",
+    "StubExecutor",
+    "drive",
+]
+
+
+def __getattr__(name):
+    """Lazy re-exports (FusedChainExecutor pulls numpy/jax on first touch)."""
+    from importlib import import_module
+
+    if name in _LAZY_EXPORTS:
+        return getattr(
+            import_module(f"simple_tip_tpu.serving.{_LAZY_EXPORTS[name]}"), name
+        )
+    raise AttributeError(f"module 'simple_tip_tpu.serving' has no attribute {name!r}")
+
+
+def __dir__():
+    """Make the lazy exports visible to dir()/tab-completion."""
+    return sorted(list(globals()) + list(_LAZY_EXPORTS))

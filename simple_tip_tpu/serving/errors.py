@@ -1,0 +1,37 @@
+"""Serving failure taxonomy: every rejection is typed, loud, and explicit.
+
+The batch path's resilience contract (resilience/) is that degradation is
+never silent — the breaker stamps records, sheds are counted and evented.
+The serving path inherits that contract at the request boundary: callers
+get a typed exception they can map straight onto an HTTP status instead of
+an unbounded queue or a hung await.
+"""
+
+from typing import Optional
+
+
+class ServingError(RuntimeError):
+    """Base of every scoring-service rejection."""
+
+
+class RequestShed(ServingError):
+    """Admission refused the request (429-style): queue or predicted-backlog
+    bound exceeded, or the request was evicted under ``shed_mode=oldest``.
+
+    ``retry_after_s`` is the cost-model-predicted backlog drain time when
+    an estimate exists (advisory, may be None — the estimate is never
+    load-bearing, matching ``obs predict``'s failure-safe contract).
+    """
+
+    def __init__(self, message: str, retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class BackendDown(ServingError):
+    """The backend is unavailable (503-style): the circuit breaker is open
+    in ``mode=fail``, or a badge dispatch exhausted its retry budget."""
+
+
+class EngineClosed(ServingError):
+    """The engine was closed while the request was queued or submitted."""

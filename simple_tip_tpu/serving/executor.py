@@ -1,0 +1,215 @@
+"""Badge executors: the warm AOT program pool and the dependency-free stub.
+
+The executor is the serving engine's ONLY backend-facing surface — the
+engine/handler split the ROADMAP asks to become a real API boundary. The
+contract:
+
+- ``register_model(key, badge_size, **spec)`` — resolve/compile everything
+  up front (the warm pool: a request must never pay a compile);
+- ``run_badge(key, segments)`` — score one badge assembled from the given
+  row segments, returning one result dict (or value list) PER segment in
+  order; called from a worker thread (sync code is fine here);
+- ``merge(parts)`` — combine per-chunk results of one request back into a
+  single response.
+
+``StubExecutor`` is stdlib-only (no jax, no numpy) so the dependency-free
+CI smoke and the batching/admission tests can drive the full engine.
+``FusedChainExecutor`` is the real thing: per-(case-study, model-id)
+``FusedChainRunner`` programs resolved through ProgramCache fingerprints
+at register time, host input ring buffers feeding the donated badge
+argument (SNIPPETS.md [3] compile_step pattern — donation is a no-op on
+CPU, buffer reuse on TPU/GPU).
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from simple_tip_tpu import obs
+
+
+class StubExecutor:
+    """In-process fake backend: per-row ``fn``, optional delay and faults.
+
+    ``delay_s`` simulates badge dispatch time (``time.sleep`` in a worker
+    thread — sync context by design); ``fail_first`` makes the first N
+    ``run_badge`` calls raise ``OSError`` (the default-transient type, so
+    retry/breaker paths are exercisable without a real outage).
+    """
+
+    def __init__(self, delay_s: float = 0.0, fail_first: int = 0):
+        self.delay_s = float(delay_s)
+        self._fail_remaining = int(fail_first)
+        self._fns: Dict[object, object] = {}
+        self.badge_log: List[object] = []  # model key per run_badge, in order
+        self._lock = threading.Lock()
+
+    def register_model(self, key, badge_size: int, fn=None) -> None:
+        """Register ``key`` with a per-row scoring callable (default: sum)."""
+        self._fns[key] = fn if fn is not None else (lambda row: sum(row))
+
+    def run_badge(self, key, segments: Sequence[Sequence]) -> List[list]:
+        """Score one badge; returns one list of per-row values per segment."""
+        with self._lock:
+            if self._fail_remaining > 0:
+                self._fail_remaining -= 1
+                raise OSError("injected stub backend fault")
+            self.badge_log.append(key)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        fn = self._fns[key]
+        return [[fn(row) for row in seg] for seg in segments]
+
+    @staticmethod
+    def merge(parts: List[list]) -> list:
+        """Concatenate per-chunk row-value lists into one response list."""
+        return [v for part in parts for v in part]
+
+
+class _WarmModel:
+    """One registered model's runner, compiled program, and input ring."""
+
+    __slots__ = ("runner", "program", "ring", "slot", "badge_size")
+
+    def __init__(self, runner, program, ring, badge_size):
+        self.runner = runner
+        self.program = program
+        self.ring = ring
+        self.slot = 0
+        self.badge_size = badge_size
+
+
+class FusedChainExecutor:
+    """Warm pool of per-model AOT chain programs behind the executor API.
+
+    Registration builds a ``FusedChainRunner`` (train-stats pass, metric
+    setup) and resolves the badge-shaped chain program through the
+    ``ProgramCache`` immediately — compile time lands in the register
+    call's ``run_program.compile`` span, never in a request. Each model
+    gets ``ring_slots`` host staging buffers cycled per badge, so the
+    buffer a donated device badge was uploaded from is never being
+    refilled while the dispatch is in flight.
+
+    Row independence makes this byte-identical to the offline walk: each
+    row's chain outputs depend only on that row and the params (padding is
+    masked by the traced ``valid``), so the scores a request gets do not
+    depend on which co-riders shared its badge.
+    """
+
+    def __init__(self, cache="env", in_shardings=None, out_shardings=None,
+                 ring_slots: int = 2):
+        self._cache = cache
+        self._in_shardings = in_shardings
+        self._out_shardings = out_shardings
+        self._ring_slots = max(1, int(ring_slots))
+        self._models: Dict[object, _WarmModel] = {}
+        self._lock = threading.Lock()
+
+    def register_model(
+        self,
+        key,
+        badge_size: int,
+        model_def=None,
+        params=None,
+        training_set=None,
+        nc_layers=None,
+        batch_size: int = 32,
+        x_dtype=None,
+    ) -> None:
+        """Build + warm one model's chain program (idempotent per key)."""
+        import numpy as np
+
+        from simple_tip_tpu.engine.run_program import FusedChainRunner
+
+        with self._lock:
+            already = self._models.get(key)
+            if already is not None and already.badge_size == int(badge_size):
+                return  # warm already: a re-register must not recompile
+
+        with obs.span(
+            "serving.register", model=str(key), badge=int(badge_size)
+        ):
+            runner = FusedChainRunner(
+                model_def,
+                params,
+                training_set,
+                nc_layers,
+                batch_size=batch_size,
+                badge_size=badge_size,
+                cache=self._cache,
+                in_shardings=self._in_shardings,
+                out_shardings=self._out_shardings,
+            )
+            training_set = np.asarray(training_set)
+            dtype = np.dtype(x_dtype) if x_dtype is not None else training_set.dtype
+            x_shape = (int(badge_size),) + training_set.shape[1:]
+            program = runner.chain_program(x_shape, dtype)
+            ring = [np.zeros(x_shape, dtype) for _ in range(self._ring_slots)]
+        with self._lock:
+            self._models[key] = _WarmModel(runner, program, ring, int(badge_size))
+
+    def runner(self, key):
+        """The registered model's ``FusedChainRunner`` (offline-walk access
+        for parity checks and AL-select reuse)."""
+        return self._models[key].runner
+
+    def run_badge(self, key, segments: Sequence) -> List[dict]:
+        """One fused chain dispatch over the assembled badge.
+
+        Returns per-segment dicts with host ``pred`` / ``uncertainties`` /
+        ``scores`` slices (the per-request response fields); padding rows
+        are computed but never surfaced.
+        """
+        import numpy as np
+
+        m = self._models[key]
+        with self._lock:
+            buf = m.ring[m.slot]
+            m.slot = (m.slot + 1) % len(m.ring)
+        off = 0
+        for seg in segments:
+            seg = np.asarray(seg)
+            buf[off : off + seg.shape[0]] = seg
+            off += seg.shape[0]
+        if off > m.badge_size:
+            raise ValueError(
+                f"badge overflow: {off} rows into a {m.badge_size}-row program"
+            )
+        buf[off:] = 0  # deterministic padding (masked by the traced valid)
+        pred_d, unc_d, cov_d = m.program(m.runner.params, buf, np.int32(off))
+        obs.counter("serving.chain_dispatches").inc()
+        pred = np.asarray(pred_d)
+        unc = {name: np.asarray(u) for name, u in unc_d.items()}
+        scores = {mid: np.asarray(s) for mid, (s, _) in cov_d.items()}
+        out, off = [], 0
+        for seg in segments:
+            n = len(seg)
+            sl = slice(off, off + n)
+            out.append(
+                {
+                    "pred": pred[sl].copy(),
+                    "uncertainties": {k: v[sl].copy() for k, v in unc.items()},
+                    "scores": {k: v[sl].copy() for k, v in scores.items()},
+                }
+            )
+            off += n
+        return out
+
+    @staticmethod
+    def merge(parts: List[dict]) -> dict:
+        """Concatenate per-chunk field arrays into one request response."""
+        import numpy as np
+
+        if len(parts) == 1:
+            return parts[0]
+        return {
+            "pred": np.concatenate([p["pred"] for p in parts]),
+            "uncertainties": {
+                k: np.concatenate([p["uncertainties"][k] for p in parts])
+                for k in parts[0]["uncertainties"]
+            },
+            "scores": {
+                k: np.concatenate([p["scores"][k] for p in parts])
+                for k in parts[0]["scores"]
+            },
+        }

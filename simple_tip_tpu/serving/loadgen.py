@@ -1,0 +1,102 @@
+"""Synthetic open-loop load generator for the scoring engine.
+
+Drives the engine at a target arrival rate (open-loop: submissions are
+scheduled by the clock, NOT gated on responses — the shape that actually
+reveals queueing collapse) and reports the SLO view the bench `serving`
+companion records: sustained inputs/s, p50/p95/p99 request latency, mean
+badge fill-ratio and shed/error counts. Stdlib-only; used by bench.py,
+the CI smoke and the tests against both executors.
+"""
+
+import asyncio
+from typing import Callable, List, Sequence
+
+from simple_tip_tpu import obs
+from simple_tip_tpu.serving.errors import ServingError
+
+
+def percentile(values: Sequence[float], q: float):
+    """Nearest-rank percentile ``q`` (0..100), or None on empty input.
+
+    Same definition as ``obs.metrics.Quantile.percentile`` so the loadgen
+    report and the live SLO telemetry cannot disagree on a quantile.
+    """
+    if not values:
+        return None
+    window = sorted(values)
+    rank = max(1, -(-int(q) * len(window) // 100))  # ceil(q*n/100)
+    return window[min(rank, len(window)) - 1]
+
+
+async def drive(
+    engine,
+    model,
+    make_rows: Callable[[int], Sequence],
+    n_requests: int,
+    rows_per_request: int,
+    arrival_rows_per_s: float,
+) -> dict:
+    """Open-loop run: ``n_requests`` of ``rows_per_request`` rows at the
+    target arrival rate; returns the measured SLO dict.
+
+    ``make_rows(i)`` builds request ``i``'s row block (seeded by the
+    caller for determinism). Sheds and backend errors are counted, never
+    raised — overload behavior IS the measurement.
+    """
+    loop = asyncio.get_running_loop()
+    interval = (
+        rows_per_request / arrival_rows_per_s if arrival_rows_per_s > 0 else 0.0
+    )
+    fill0 = obs.metrics_snapshot()["histograms"].get("serving.badge_fill") or {
+        "count": 0,
+        "sum": 0.0,
+    }
+    latencies_ms: List[float] = []
+    outcomes = {"ok": 0, "shed": 0, "error": 0}
+    t_start = loop.time()
+
+    async def one(i: int, t_target: float):
+        delay = t_target - loop.time()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        t0 = loop.time()
+        try:
+            await engine.score(model, make_rows(i))
+        except ServingError:
+            outcomes["shed"] += 1
+            return
+        except Exception:  # noqa: BLE001 — measured, not raised
+            outcomes["error"] += 1
+            return
+        outcomes["ok"] += 1
+        latencies_ms.append((loop.time() - t0) * 1000.0)
+
+    await asyncio.gather(
+        *(one(i, t_start + i * interval) for i in range(n_requests))
+    )
+    elapsed = max(loop.time() - t_start, 1e-9)
+    fill1 = obs.metrics_snapshot()["histograms"].get("serving.badge_fill") or {
+        "count": 0,
+        "sum": 0.0,
+    }
+    n_badges = fill1["count"] - fill0["count"]
+    fill = (
+        (fill1["sum"] - fill0["sum"]) / n_badges if n_badges > 0 else None
+    )
+    return {
+        "requests": n_requests,
+        "rows_per_request": rows_per_request,
+        "arrival_rows_per_s": round(arrival_rows_per_s, 1),
+        "sustained_inputs_per_s": round(
+            outcomes["ok"] * rows_per_request / elapsed, 1
+        ),
+        "ok": outcomes["ok"],
+        "shed": outcomes["shed"],
+        "errors": outcomes["error"],
+        "p50_ms": percentile(latencies_ms, 50),
+        "p95_ms": percentile(latencies_ms, 95),
+        "p99_ms": percentile(latencies_ms, 99),
+        "badge_fill": round(fill, 4) if fill is not None else None,
+        "badges": n_badges,
+        "elapsed_s": round(elapsed, 4),
+    }

@@ -35,6 +35,16 @@ for _var in [
 ]:
     os.environ.pop(_var, None)
 
+# Inherited serving knobs (a developer tuning the online engine, a CI lane
+# that exported a flush deadline for the smoke) would silently reshape the
+# badge sizes, queue bounds and shed modes the serving tests pin; the bench
+# companion toggle would flip the serving measurement on/off under the
+# bench fixtures. Cleared here; serving tests set them per-test.
+for _var in [v for v in os.environ if v.startswith("TIP_SERVE_")] + [
+    "TIP_BENCH_SERVING"
+]:
+    os.environ.pop(_var, None)
+
 # An inherited fused-chain toggle would silently reroute every prio-path
 # test through the AOT program layer (and a developer's program-cache dir
 # would leak compiled executables across suites); the fused path is opted

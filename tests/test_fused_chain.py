@@ -24,9 +24,11 @@ from simple_tip_tpu.ops.fused_chain import (
     ThresholdCodebook,
     make_chain_fn,
     make_group_chain_fn,
+    make_select_fn,
     pack_bits_u32,
     rank_badges,
     rank_badges_grouped,
+    select_top_k,
 )
 from simple_tip_tpu.ops.prioritizers import device_cam_greedy, pack_profiles
 from simple_tip_tpu.ops.uncertainty import POINT_PRED_QUANTIFIERS
@@ -156,6 +158,33 @@ def test_rank_badges_matches_device_cam(tiny_setup):
     for g in range(2):
         assert int(g_count[g]) == int(ref_count)
         np.testing.assert_array_equal(np.asarray(g_picked[g]), np.asarray(ref_picked))
+
+
+def test_select_top_k_matches_numpy_stable_argsort():
+    """The traced AL top-k select == numpy's stable ascending argsort tail
+    (the consumer contract: active-learning pick order must not depend on
+    which path computed it), with padding rows masked by ``valid``."""
+    rng = np.random.RandomState(13)
+    sel = jax.jit(make_select_fn(5))
+    for n, valid in ((16, 16), (16, 12), (32, 9)):
+        vals = rng.rand(n).astype(np.float32)
+        vals[: valid // 2] = vals[valid // 2 : 2 * (valid // 2)]  # force ties
+        got = np.asarray(sel(jnp.asarray(vals), np.int32(valid)))
+        want = np.argsort(vals[:valid], kind="stable")[-5:]
+        np.testing.assert_array_equal(got, want)
+        # padding indices must never be picked
+        assert (got < valid).all()
+
+
+def test_select_top_k_traced_equals_eager():
+    """The jit-free op form matches the AOT-lowered closure form."""
+    rng = np.random.RandomState(17)
+    vals = rng.rand(24).astype(np.float32)
+    eager = np.asarray(select_top_k(jnp.asarray(vals), np.int32(24), 7))
+    lowered = np.asarray(
+        jax.jit(make_select_fn(7))(jnp.asarray(vals), np.int32(24))  # tiplint: disable=retrace-risk (one-shot per-test compile)
+    )
+    np.testing.assert_array_equal(eager, lowered)
 
 
 def test_int8_codebook_exact_on_nan_and_ties():

@@ -96,6 +96,31 @@ def test_metrics_registry_and_flush(obs_dir):
     assert flushed and flushed[-1]["counters"]["c"] == 3
 
 
+def test_quantile_nearest_rank_and_snapshot(obs_dir):
+    """Quantiles use the nearest-rank definition (a value some request
+    actually saw) and surface under the ADDITIVE 'quantiles' snapshot key —
+    absent entirely when no quantile instrument exists, so pre-serving
+    snapshot consumers see the exact dict they always did."""
+    assert "quantiles" not in obs.metrics_snapshot()
+    q = obs.quantile("lat_ms")
+    for v in range(1, 101):
+        q.observe(v)
+    snap = obs.metrics_snapshot()["quantiles"]["lat_ms"]
+    assert snap == {"count": 100, "p50": 50, "p95": 95, "p99": 99}
+    assert obs.quantile("lat_ms").percentile(100) == 100
+
+
+def test_quantile_window_keeps_most_recent(obs_dir):
+    """The ring is a sliding window: old observations age out at cap, the
+    way an SLO dashboard reads recent latency rather than lifetime."""
+    q = obs.quantile("w", cap=4)
+    for v in (1000.0, 1000.0, 1.0, 2.0, 3.0, 4.0):
+        q.observe(v)
+    assert q.count == 6
+    assert q.percentile(99) == 4.0  # the 1000s aged out
+    assert q.percentile(50) == 2.0
+
+
 def test_auto_dir_resolves_under_assets_and_pins_env(tmp_path, monkeypatch):
     monkeypatch.setenv("TIP_ASSETS", str(tmp_path))
     monkeypatch.setenv("TIP_OBS_DIR", "auto")

@@ -890,6 +890,86 @@ def decorated_in_loop(badges):
 '''
 }
 
+BAD_BLOCKING_ASYNC = {
+    "serving/handler.py": '''"""m."""
+import time
+
+
+async def flush_badge(batcher):
+    """time.sleep in a coroutine stalls every tenant's requests."""
+    time.sleep(0.025)
+    return batcher.take_ready(0.0, force=True)
+
+
+async def join_dispatch(fut):
+    """Blocking .result() parks the scheduler on one future."""
+    return fut.result()
+
+
+async def load_manifest(path):
+    """Sync file IO holds the loop for the disk's latency."""
+    with open(path) as fh:
+        return fh.read()
+''',
+    "serving/handler_from_import.py": '''"""m."""
+from time import sleep
+
+
+async def backoff():
+    """from-import sleep is the same stall."""
+    sleep(1.0)
+''',
+}
+
+GOOD_BLOCKING_ASYNC = {
+    "serving/handler.py": '''"""m."""
+import asyncio
+import time
+
+
+async def flush_badge(batcher):
+    """The async sleep yields the loop to other tenants."""
+    await asyncio.sleep(0.025)
+    return batcher.take_ready(0.0, force=True)
+
+
+async def join_dispatch(fut):
+    """Awaiting keeps the scheduler responsive while waiting."""
+    return await fut
+
+
+async def run_badge(loop, executor_fn):
+    """Blocking work lives in a sync helper run off-loop; the nested
+    sync def's body executes in the executor thread, not the loop."""
+
+    def dispatch():
+        """d."""
+        time.sleep(0.01)
+        return executor_fn()
+
+    return await loop.run_in_executor(None, dispatch)
+
+
+def warm_pool_wait(check):
+    """Sync library code may sleep; only coroutine bodies stall a loop."""
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if check():
+            return True
+        time.sleep(0.1)
+    return False
+''',
+    # A smoke script driving its own private loop harms nobody.
+    "scripts/serve_probe.py": '''"""m."""
+import time
+
+
+async def probe(fut):
+    time.sleep(0.5)
+    return fut.result()
+''',
+}
+
 FIXTURES = {
     "jit-purity": (BAD_JIT_PURITY, GOOD_JIT_PURITY),
     "retrace-risk": (BAD_RETRACE_RISK, GOOD_RETRACE_RISK),
@@ -908,6 +988,7 @@ FIXTURES = {
     "transitive-jit-purity": (BAD_TRANSITIVE, GOOD_TRANSITIVE),
     "unfenced-claim": (BAD_UNFENCED_CLAIM, GOOD_UNFENCED_CLAIM),
     "unversioned-schema": (BAD_UNVERSIONED_SCHEMA, GOOD_UNVERSIONED_SCHEMA),
+    "blocking-in-async": (BAD_BLOCKING_ASYNC, GOOD_BLOCKING_ASYNC),
 }
 
 
