@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""CI smoke for the live telemetry plane (obs/exporter.py + obs/live.py).
+
+Dependency-free by design (stdlib only, like the exporter itself): boots
+the exporter on an ephemeral port (``TIP_OBS_HTTP=auto``), seeds the
+in-memory metrics registry, mounts /slo and /fleet providers plus health
+components, then curls all four routes over real HTTP and validates:
+
+- ``/healthz`` answers 200 with ``ok: true``, flips to 503 when any
+  component is pushed unhealthy, and recovers to 200;
+- ``/metrics`` is valid Prometheus text exposition — every line must
+  match the exposition-format line grammar, ``tip_up 1`` is present, and
+  the seeded counter/gauge/quantile families all render;
+- ``/slo`` and ``/fleet`` serve the mounted provider JSON (and 404 once
+  the provider is cleared);
+- unknown routes 404; a provider that raises answers 500 without
+  killing the server;
+- a second ``start()`` is a no-op returning the same port, and the
+  exporter is a no-op when ``TIP_OBS_HTTP`` is unset.
+
+With ``--trace DIR`` (CI passes the freshly generated 2-worker study)
+the live CLI is smoked too: ``obs tail`` one-shot and ``obs top --once``
+must both exit 0 against the real streams.
+
+Exit 0 on success, 1 with a diagnostic on the first failed check.
+"""
+
+import argparse
+import io
+import json
+import os
+import re
+import sys
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Exposition-format line grammar: comments/HELP/TYPE, or a sample line
+# `name{labels} value` with an optional exemplar-free float value.
+_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"[-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|[0-9]+)$"
+)
+_COMMENT = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def _get(port: int, path: str):
+    """GET a route; returns (status, body-str) without raising on 4xx/5xx."""
+    url = f"http://127.0.0.1:{port}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--trace", default=None,
+        help="obs run directory to smoke `obs tail`/`obs top` against",
+    )
+    args = ap.parse_args()
+
+    from simple_tip_tpu import obs
+    from simple_tip_tpu.obs import exporter
+
+    # -- no-op contract: unset knob means no server, no thread ------------
+    os.environ.pop("TIP_OBS_HTTP", None)
+    exporter.reset()
+    if exporter.start() is not None or exporter.enabled():
+        return _fail("exporter must be a no-op with TIP_OBS_HTTP unset")
+
+    # -- boot on an ephemeral port + seed the registry --------------------
+    os.environ["TIP_OBS_HTTP"] = "auto"
+    obs.counter("smoke.requests").inc(3)
+    obs.gauge("smoke.queue_depth").set(7)
+    obs.histogram("smoke.batch_s").observe(0.25)
+    for ms in (12.0, 15.0, 40.0):
+        obs.quantile("smoke.request_ms").observe(ms)
+
+    port = exporter.start()
+    if port is None:
+        return _fail("exporter.start() returned None with TIP_OBS_HTTP=auto")
+    if exporter.start() != port:
+        return _fail("second start() must be an idempotent no-op (same port)")
+
+    exporter.set_health("smoke", ok=True, note="ci")
+    exporter.set_provider("slo", lambda: {"schema": 1, "queue_rows": 0})
+    exporter.set_provider(
+        "fleet", lambda: {"schema": 1, "members": [], "leases": []}
+    )
+
+    # -- /healthz: 200 -> 503 on an unhealthy component -> recover --------
+    status, body = _get(port, "/healthz")
+    doc = json.loads(body)
+    if status != 200 or doc.get("ok") is not True:
+        return _fail(f"/healthz expected 200 ok=true, got {status} {body!r}")
+    if doc["components"].get("smoke", {}).get("note") != "ci":
+        return _fail(f"/healthz must carry pushed component details: {body!r}")
+    exporter.set_health("breaker", ok=False, state="open")
+    status, body = _get(port, "/healthz")
+    if status != 503 or json.loads(body).get("ok") is not False:
+        return _fail(f"/healthz expected 503 ok=false, got {status} {body!r}")
+    exporter.set_health("breaker", ok=True, state="closed")
+    status, _ = _get(port, "/healthz")
+    if status != 200:
+        return _fail(f"/healthz must recover to 200, got {status}")
+
+    # -- /metrics: Prometheus line grammar + seeded families --------------
+    status, text = _get(port, "/metrics")
+    if status != 200:
+        return _fail(f"/metrics expected 200, got {status}")
+    if not text.endswith("\n"):
+        return _fail("/metrics body must end with a trailing newline")
+    for line in text.splitlines():
+        if not line:
+            continue
+        if not (_COMMENT.match(line) or _SAMPLE.match(line)):
+            return _fail(f"/metrics line fails exposition grammar: {line!r}")
+    for needle in (
+        "tip_up 1",
+        "tip_smoke_requests_total 3",
+        "tip_smoke_queue_depth 7",
+        'tip_smoke_request_ms{quantile="0.95"}',
+        "tip_smoke_batch_s_count 1",
+        'tip_health_ok{component="smoke"} 1',
+    ):
+        if needle not in text:
+            return _fail(f"/metrics missing {needle!r}:\n{text}")
+
+    # -- providers: JSON routes, 404 when unmounted, 500 on a raise -------
+    for route in ("/slo", "/fleet"):
+        status, body = _get(port, route)
+        if status != 200 or json.loads(body).get("schema") != 1:
+            return _fail(f"{route} expected provider JSON, got {status} {body!r}")
+    status, _ = _get(port, "/nope")
+    if status != 404:
+        return _fail(f"unknown route expected 404, got {status}")
+    exporter.set_provider("slo", lambda: 1 // 0)
+    status, _ = _get(port, "/slo")
+    if status != 500:
+        return _fail(f"raising provider expected 500, got {status}")
+    exporter.clear_provider("slo")
+    status, _ = _get(port, "/slo")
+    if status != 404:
+        return _fail(f"cleared provider expected 404, got {status}")
+    status, _ = _get(port, "/healthz")
+    if status != 200:
+        return _fail("server must survive a raising provider")
+
+    exporter.reset()
+    os.environ.pop("TIP_OBS_HTTP", None)
+    print(f"exporter smoke OK (served 4 routes on 127.0.0.1:{port})")
+
+    # -- live CLI one-shots against a real study trace --------------------
+    if args.trace:
+        from simple_tip_tpu.obs import cli
+
+        out = io.StringIO()
+        sys.stdout = out
+        try:
+            rc_tail = cli.main(["tail", args.trace])
+            rc_top = cli.main(["top", args.trace, "--once"])
+        finally:
+            sys.stdout = sys.__stdout__
+        if rc_tail != 0:
+            return _fail(f"`obs tail {args.trace}` exited {rc_tail}")
+        if rc_top != 0:
+            return _fail(f"`obs top --once {args.trace}` exited {rc_top}")
+        lines = out.getvalue().count("\n")
+        print(f"live CLI smoke OK (tail+top over {args.trace}: {lines} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,7 @@ from simple_tip_tpu.analysis.rules import (  # noqa: F401
     artifact_contract,
     bare_print,
     blocking_async,
+    blocking_endpoint,
     buffer_donation,
     docstring_coverage,
     f64_on_tpu,

@@ -1,0 +1,145 @@
+"""Rule ``blocking-endpoint``: slow work inside HTTP handler bodies.
+
+The obs exporter's contract (obs/exporter.py) is push-model: handler
+threads serve ONLY the in-memory state the owning loops already pushed —
+the metrics registry, the ``set_health`` dict, provider callables
+returning cached views. The moment a handler body walks the filesystem,
+probes a flock, shells out, or touches jax, a ``curl /healthz`` during an
+outage inherits the very stall it exists to report (the journal wedge
+probe blocking a health scrape is the canonical self-own), and a scrape
+storm multiplies disk traffic by request rate. Filesystem-backed inputs
+belong on the scheduler/fleet loops' cadence, pushed in via
+``exporter.set_health`` / ``set_provider``.
+
+Flagged lexically inside handler method bodies — methods named ``do_*``
+of any class whose base-name mentions ``HTTPRequestHandler``, plus their
+sibling helpers those classes define — skipping nested ``def``/lambda
+scopes (their bodies execute elsewhere):
+
+- builtin ``open(...)`` and ``os.{listdir,scandir,walk,stat,lstat,
+  remove,unlink,rename,replace,makedirs}`` — filesystem IO;
+- ``glob.*`` / ``shutil.*`` / ``subprocess.*`` — tree walks and child
+  processes;
+- ``time.sleep(...)`` — a deliberate stall on a serving thread;
+- any attribute chain rooted at ``jax`` — device work has no business on
+  a health endpoint.
+
+Exempt (same surface logic as ``bare-print``): ``scripts/``, ``tests/``,
+entry-point modules, and test modules — a throwaway smoke handler may
+read fixtures directly.
+"""
+
+import ast
+from typing import Iterator, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.bare_print import _exempt
+
+_NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# os functions that hit the filesystem (reads AND mutations): any of these
+# on a handler thread turns a scrape into disk traffic.
+_OS_FS = frozenset(
+    (
+        "listdir", "scandir", "walk", "stat", "lstat", "remove", "unlink",
+        "rename", "replace", "makedirs", "mkdir", "rmdir", "open",
+    )
+)
+
+# Modules whose every call is slow-path by construction.
+_SLOW_MODULES = frozenset(("glob", "shutil", "subprocess"))
+
+
+def _handler_classes(tree: ast.Module):
+    """Classes that look like ``http.server`` request handlers: a base
+    name mentioning ``HTTPRequestHandler``, or any ``do_*`` method."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        base_names = []
+        for base in node.bases:
+            if isinstance(base, ast.Name):
+                base_names.append(base.id)
+            elif isinstance(base, ast.Attribute):
+                base_names.append(base.attr)
+        if any("HTTPRequestHandler" in b for b in base_names):
+            yield node
+        elif any(
+            isinstance(item, ast.FunctionDef) and item.name.startswith("do_")
+            for item in node.body
+        ):
+            yield node
+
+
+def _method_body_nodes(fn: ast.FunctionDef):
+    """Nodes lexically in ``fn``'s body, not descending into nested
+    scopes (their code runs wherever they are called, not per-request)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _NESTED_SCOPES):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _attr_root(node: ast.Attribute) -> str:
+    """Leftmost name of an attribute chain (``jax.devices`` -> ``jax``)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _blocking_reason(call: ast.Call) -> str:
+    """Why this call must not run on a handler thread ('' = fine)."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        if fn.id == "open":
+            return "sync file IO (open())"
+        return ""
+    if not isinstance(fn, ast.Attribute):
+        return ""
+    root = _attr_root(fn)
+    if root == "os" and fn.attr in _OS_FS:
+        return f"filesystem call (os.{fn.attr})"
+    if root in _SLOW_MODULES:
+        return f"slow-path call ({root}.{fn.attr})"
+    if root == "time" and fn.attr == "sleep":
+        return "time.sleep()"
+    if root == "jax":
+        return f"jax call (jax...{fn.attr})"
+    return ""
+
+
+@register
+class BlockingEndpointRule(Rule):
+    """Flag filesystem/subprocess/sleep/jax calls in HTTP handler bodies."""
+
+    name = "blocking-endpoint"
+    description = (
+        "filesystem walk / subprocess / sleep / jax call inside an HTTP "
+        "handler body; endpoints serve only pushed in-memory state — move "
+        "the slow work onto the owning loop's cadence and push it in via "
+        "exporter.set_health/set_provider (scripts/tests exempt)"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Flag blocking calls lexically inside handler method bodies."""
+        if _exempt(module):
+            return
+        for cls in _handler_classes(module.tree):
+            for item in cls.body:
+                if not isinstance(item, ast.FunctionDef):
+                    continue
+                for node in _method_body_nodes(item):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    reason = _blocking_reason(node)
+                    if reason:
+                        yield "", node.lineno, (
+                            f"{reason} inside HTTP handler "
+                            f"{cls.name}.{item.name}: endpoint threads "
+                            "serve only in-memory pushed state; do this "
+                            "on the owning loop and push the result via "
+                            "exporter.set_health/set_provider"
+                        )

@@ -15,6 +15,17 @@ per-phase summary table or one Perfetto/Chrome flame chart:
 - ``python -m simple_tip_tpu.obs summary|export|check|regress``  inspection
 - ``python -m simple_tip_tpu.obs runs|predict|trend``     feature store,
   cost model, N-run trend gate (obs v3)
+- ``python -m simple_tip_tpu.obs tail|top|audit``         live tail, live
+  progress table, plan-vs-actual cost-model audit (obs v4)
+
+obs v4 adds the live telemetry plane: ``exporter`` mounts a stdlib HTTP
+daemon thread (``TIP_OBS_HTTP=port|auto``, no-op when unset) serving
+``/healthz`` (200/503 from pushed breaker/journal/lease component
+health), ``/metrics`` (the registry incl. Quantile windows as Prometheus
+text), ``/slo`` (the serving engine's snapshot) and ``/fleet`` (the
+coordinator's membership/lease view); ``live`` is the torn-tail-tolerant
+merged tail, the refreshing top table, and the predicted_s-vs-actual_s
+audit that feeds cost-model drift back through ``obs trend``.
 
 obs v2 adds the trace lifecycle (``TIP_OBS_MAX_BYTES`` rotating size cap
 with oldest-segment eviction, ``TIP_OBS_SAMPLE`` keep-1-in-N span
@@ -82,9 +93,10 @@ __all__ = [
 
 
 def reset_all() -> None:
-    """Full test-hook reset: tracer state, metrics registry, log bridge."""
-    from simple_tip_tpu.obs import logbridge, metrics, tracer
+    """Full test-hook reset: tracer, metrics registry, log bridge, exporter."""
+    from simple_tip_tpu.obs import exporter, logbridge, metrics, tracer
 
     tracer.reset()
     metrics.reset()
     logbridge.reset()
+    exporter.reset()

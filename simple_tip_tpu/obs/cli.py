@@ -504,6 +504,17 @@ def _predict(args) -> int:
 
     rows = store.load_rows(args.index or store.default_index_dir())
     if not rows:
+        if args.json:
+            # The --json contract: stdout ALWAYS carries one valid JSON
+            # document, even on the exit-3 path — diagnostics stay on
+            # stderr so piped consumers never parse an empty/corrupt body.
+            print(
+                json.dumps(
+                    {"ok": False, "error": "insufficient_corpus",
+                     "phases": {}, "total_s": None},
+                    indent=2, sort_keys=True,
+                )
+            )
         print(
             "obs predict: the feature-store index is empty — run "
             "`obs runs <roots>` first (exit 3: insufficient corpus)",
@@ -714,7 +725,81 @@ def main(argv=None) -> int:
     )
     tp.add_argument("--json", action="store_true", help="machine-readable output")
 
+    tailp = sub.add_parser(
+        "tail",
+        help="merged live tail of a run's event streams (obs v4)",
+    )
+    tailp.add_argument("target", nargs="+", help="run directory or .jsonl files")
+    tailp.add_argument(
+        "-f", "--follow", action="store_true",
+        help="keep polling for appended events (live mode)",
+    )
+    tailp.add_argument(
+        "--poll", type=float, default=0.5, metavar="S",
+        help="follow-mode poll interval in seconds (default 0.5)",
+    )
+    tailp.add_argument(
+        "--duration", type=float, default=None, metavar="S",
+        help="stop following after S seconds (default: a day)",
+    )
+    tailp.add_argument(
+        "--max-events", type=int, default=None, metavar="N",
+        help="stop after printing N events",
+    )
+
+    topp = sub.add_parser(
+        "top",
+        help="refreshing phase-progress / queue-depth / badge-fill table",
+    )
+    topp.add_argument("target", nargs="+", help="run directory or .jsonl files")
+    topp.add_argument(
+        "--refresh", type=float, default=2.0, metavar="S",
+        help="refresh interval in seconds (default 2)",
+    )
+    topp.add_argument(
+        "--iterations", type=int, default=None, metavar="N",
+        help="render N refreshes then exit (default: until Ctrl-C)",
+    )
+    topp.add_argument(
+        "--once", action="store_true", help="one-shot render (CI/tests)"
+    )
+
+    audp = sub.add_parser(
+        "audit",
+        help="grade predicted_s vs actual_s across a run's phase spans; "
+        "emit per-phase error rows (exit 3 when nothing carries a pair)",
+    )
+    audp.add_argument(
+        "targets", nargs="+", help="run directories or .jsonl files"
+    )
+    audp.add_argument(
+        "--index", default=None, metavar="DIR",
+        help="also refresh these targets into the feature-store index "
+        "(emits the audit.* error rows)",
+    )
+    audp.add_argument(
+        "--json", action="store_true",
+        help="emit the trend-gateable audit snapshot document",
+    )
+
     args = ap.parse_args(argv)
+
+    if args.command in ("tail", "top", "audit"):
+        from simple_tip_tpu.obs import live as live_mod
+
+        if args.command == "tail":
+            return live_mod.tail(
+                args.target, follow=args.follow, poll_s=args.poll,
+                duration_s=args.duration, max_events=args.max_events,
+            )
+        if args.command == "top":
+            iterations = 1 if args.once else args.iterations
+            return live_mod.top(
+                args.target, refresh_s=args.refresh, iterations=iterations
+            )
+        return live_mod.audit(
+            args.targets, index=args.index, as_json=args.json
+        )
 
     if args.command == "regress":
         return _regress(args)

@@ -100,6 +100,14 @@ class Histogram:
         return self
 
 
+def _nearest_rank(window, q: float):
+    """Nearest-rank percentile ``q`` (0..100) of a pre-sorted window."""
+    if not window:
+        return None
+    rank = max(1, -(-int(q) * len(window) // 100))  # ceil(q*n/100)
+    return window[min(rank, len(window)) - 1]
+
+
 class Quantile:
     """Sliding-window percentile estimator (SLO p50/p95/p99).
 
@@ -109,6 +117,12 @@ class Quantile:
     whole lifetime. ``percentile`` uses the nearest-rank definition, so
     p50 of [1, 2, 3] is 2, never an interpolated value no request actually
     saw.
+
+    ``summary`` copies and sorts the ring ONCE under the registry lock, so
+    its three percentiles describe a single consistent window even while
+    writer threads (the serving dispatch pool) observe concurrently —
+    p50 <= p95 <= p99 holds by construction, which three independent
+    ``percentile`` calls could not guarantee mid-mutation.
     """
 
     __slots__ = ("count", "cap", "_ring", "_idx")
@@ -135,18 +149,19 @@ class Quantile:
         """Nearest-rank percentile ``q`` (0..100) of the window, or None."""
         with _lock:
             window = sorted(self._ring)
-        if not window:
-            return None
-        rank = max(1, -(-int(q) * len(window) // 100))  # ceil(q*n/100)
-        return window[min(rank, len(window)) - 1]
+        return _nearest_rank(window, q)
 
     def summary(self) -> dict:
-        """JSON-safe p50/p95/p99 + total observation count."""
+        """JSON-safe p50/p95/p99 + total observation count (one atomic
+        copy-under-lock capture of the window; see the class docstring)."""
+        with _lock:
+            count = self.count
+            window = sorted(self._ring)
         return {
-            "count": self.count,
-            "p50": self.percentile(50),
-            "p95": self.percentile(95),
-            "p99": self.percentile(99),
+            "count": count,
+            "p50": _nearest_rank(window, 50),
+            "p95": _nearest_rank(window, 95),
+            "p99": _nearest_rank(window, 99),
         }
 
 
@@ -189,6 +204,13 @@ def quantile(name: str, cap: int = 512) -> Quantile:
 def snapshot() -> dict:
     """Point-in-time registry state as plain JSON-safe dicts.
 
+    The whole snapshot — quantile summaries included — is built under the
+    (re-entrant) registry lock, so readers like ``slo_snapshot()`` and the
+    exporter's ``/metrics`` see one coherent view while the batcher's
+    dispatch threads mutate the windows: a quantile summary can never mix
+    two windows, and counters/quantiles never disagree about which badges
+    have landed.
+
     The ``quantiles`` key is additive next to the original three — the
     metrics event schema (obs/cli.py REQUIRED_KEYS) only pins presence of
     counters/gauges/histograms, so older readers keep parsing.
@@ -202,9 +224,10 @@ def snapshot() -> dict:
                 for k, h in sorted(_hists.items())
             },
         }
-        quantiles = list(sorted(_quantiles.items()))
-    if quantiles:
-        snap["quantiles"] = {k: q.summary() for k, q in quantiles}
+        if _quantiles:
+            snap["quantiles"] = {
+                k: q.summary() for k, q in sorted(_quantiles.items())
+            }
     return snap
 
 

@@ -194,6 +194,25 @@ def load_snapshot(target) -> dict:
     if isinstance(doc.get("parsed"), dict):  # BENCH_r0*.json driver wrapper
         doc = doc["parsed"]
 
+    if doc.get("kind") == "audit" and isinstance(doc.get("phases"), dict):
+        # `obs audit --json` document (obs v4): per-phase mean absolute
+        # prediction error in seconds, pre-shaped for trend gating — a
+        # chronological series of audits fails `obs trend` when the cost
+        # model drifts out of its historical error band.
+        snap = _blank_snapshot("audit", str(target))
+        snap["phases"] = {
+            str(k): float(v)
+            for k, v in doc["phases"].items()
+            if isinstance(v, (int, float))
+        }
+        counters = doc.get("counters") or {}
+        snap["counters"] = {
+            k: v for k, v in counters.items() if isinstance(v, (int, float))
+        }
+        if "degraded" in doc:
+            snap["degraded"] = bool(doc.get("degraded"))
+        return snap
+
     if "metric" in doc and "value" in doc:  # bench record
         return _normalize_bench(doc, str(target))
 

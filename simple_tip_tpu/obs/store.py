@@ -241,6 +241,27 @@ def _rows_from_obs_run(path: str, seq: int) -> list:
             row["workers"] = attrs.get("workers")
             row["case_study"] = attrs.get("case_study")
             rows.append(stamp(row, rec.get("ts")))
+            # Plan-vs-actual audit row (obs v4): when the scheduler stamped
+            # a cost-model prediction next to the measured duration, the
+            # grading error becomes its own feature — ``seconds`` is the
+            # absolute error, ``value`` the signed relative error — so
+            # `obs audit`/`obs trend` can gate cost-model drift from the
+            # same index that feeds the model.
+            pred = attrs.get("predicted_s")
+            act = attrs.get("actual_s")
+            if isinstance(pred, (int, float)) and isinstance(act, (int, float)):
+                arow = _blank_row("obs_run", path, seq)
+                arow["phase"] = f"audit.{attrs['phase']}"
+                arow["seconds"] = round(abs(float(act) - float(pred)), 6)
+                arow["value"] = (
+                    round((float(act) - float(pred)) / float(pred), 6)
+                    if pred
+                    else None
+                )
+                arow["count"] = attrs.get("runs", 1)
+                arow["workers"] = attrs.get("workers")
+                arow["case_study"] = attrs.get("case_study")
+                rows.append(stamp(arow, rec.get("ts")))
         else:
             # Prio-scoring spans carry a variant attr: split them into
             # per-variant features (sa_score.pc-mlsa, ...) so `obs predict`

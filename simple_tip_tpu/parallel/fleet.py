@@ -129,6 +129,7 @@ class FleetContext:
         self.beat_interval_s = member_ttl_s / _BEATS_PER_TTL
         self._journal = journal
         self._coord_tok = None
+        self._last_fleet_view: Dict = {}
         self._ticks = 0
         self._last_beat = 0.0  # monotonic; 0 forces a beat on the first tick
         self._last_elsewhere = 0.0
@@ -182,6 +183,9 @@ class FleetContext:
         self._last_beat = now
         self.members.beat(role=role, phase=self.phase)
         self._coordinate()
+        # Refresh the cached /fleet view on the same cadence: the bus reads
+        # happen here (one beat per interval), never on an HTTP thread.
+        self.fleet_view()
 
     def _coordinate(self) -> None:
         """Renew-or-contest the coordinator lease; speculate if we hold it."""
@@ -275,6 +279,72 @@ class FleetContext:
                     "lease expired for speculative re-run",
                     unit, rec.get("owner"), age, timeout,
                 )
+
+    # -- live fleet view (the exporter's /fleet route) ---------------------
+
+    def fleet_view(self) -> Dict:
+        """Aggregate the fleet's live state into one JSON-safe dict.
+
+        Per-host heartbeat age with a ``stale`` verdict (age past the
+        membership TTL — the host stopped beating but has not rejoined),
+        the coordinator lease (owner + fencing epoch), every in-flight
+        work lease with its age and straggler verdict (the same timeout
+        the coordinator speculates on), and this member's own identity.
+
+        Reads the filesystem bus, so callers refresh it on the beat
+        cadence (``tick()`` does) and the HTTP exporter serves the CACHED
+        copy via :meth:`last_fleet_view` — handlers never walk the bus.
+        """
+        now = fleet_now()
+        members = {}
+        for host, rec in self.members.table().items():
+            age = max(0.0, now - float(rec.get("ts", now)))
+            members[host] = {
+                "age_s": round(age, 3),
+                "stale": age > self.members.ttl_s,
+                "role": rec.get("role"),
+                "phase": rec.get("phase"),
+                "pid": rec.get("pid"),
+            }
+        timeout = self._straggler_timeout()
+        coordinator = None
+        leases = []
+        for rec in self.leases.active():
+            unit = rec.get("unit")
+            age = max(0.0, now - float(rec.get("claimed_ts", now)))
+            entry = {
+                "unit": unit,
+                "owner": rec.get("owner"),
+                "epoch": rec.get("epoch"),
+                "age_s": round(age, 3),
+            }
+            if unit == COORDINATOR_UNIT:
+                coordinator = entry
+                continue
+            entry["verdict"] = (
+                "straggler" if timeout is not None and age > timeout else "ok"
+            )
+            leases.append(entry)
+        view = {
+            "host": self.host_id,
+            "case_study": self.case_study,
+            "phase": self.phase,
+            "is_coordinator": self._coord_tok is not None,
+            "coordinator": coordinator,
+            "members": members,
+            "member_ttl_s": self.members.ttl_s,
+            "leases": sorted(leases, key=lambda r: str(r["unit"])),
+            "in_flight": len(leases),
+            "straggler_timeout_s": timeout,
+            "ts": now,
+        }
+        self._last_fleet_view = view
+        return view
+
+    def last_fleet_view(self) -> Dict:
+        """The newest :meth:`fleet_view` result — a pure in-memory read,
+        safe as an exporter route provider."""
+        return self._last_fleet_view
 
     # -- claims ------------------------------------------------------------
 
@@ -493,6 +563,16 @@ def run_phase_fleet(
         root, "fleet-parent", case_study, phase,
         lease_ttl_s=lease_ttl_s, member_ttl_s=member_ttl_s, journal=journal,
     )
+    # Live telemetry plane (obs v4): the fleet parent serves the
+    # coordinator-aggregated /fleet view (and /healthz//metrics) while the
+    # fleet runs. No-op unless TIP_OBS_HTTP is set; members do not mount —
+    # one port, one aggregated view.
+    from simple_tip_tpu.obs import exporter
+
+    http_port = exporter.start()
+    if http_port is not None:
+        exporter.set_provider("fleet", probe.last_fleet_view)
+        probe.fleet_view()  # seed the cache so the route is never empty
 
     ctx = mp.get_context("spawn")
     members: List = []
@@ -526,12 +606,27 @@ def run_phase_fleet(
 
     standbys = 0
     deadline = time.monotonic() + deadline_s
+    next_view = 0.0
     try:
         while _unresolved():
             if time.monotonic() > deadline:
                 raise RuntimeError(
                     f"fleet did not drain within {deadline_s:.0f}s; "
                     f"unresolved: {_unresolved()}"
+                )
+            if http_port is not None and time.monotonic() >= next_view:
+                # Refresh the cached /fleet view on the beat cadence from
+                # THIS loop — handler threads only ever read the cache.
+                next_view = time.monotonic() + probe.beat_interval_s
+                view = probe.fleet_view()
+                fleet_members = view.get("members", {})
+                alive = [
+                    h for h, m in fleet_members.items() if not m.get("stale")
+                ]
+                exporter.set_health(
+                    "fleet", ok=bool(alive), members_alive=len(alive),
+                    members_total=len(fleet_members),
+                    unresolved=len(_unresolved()),
                 )
             if not any(p.is_alive() for p in members):
                 if standbys >= max_standbys:
@@ -546,6 +641,11 @@ def run_phase_fleet(
                 _spawn_member(f"standby{standbys}", {})
             time.sleep(0.2)
     finally:
+        if http_port is not None:
+            # Unhook: the probe goes out of scope with this call frame, and
+            # a dangling provider would serve a view of a finished fleet.
+            exporter.clear_provider("fleet")
+            exporter.clear_health("fleet")
         for p in members:
             p.join(timeout=30)
             if p.is_alive():

@@ -72,6 +72,16 @@ _STARTUP_GRACE_S = 120.0
 # end-of-phase value.
 _DEFAULT_MEMPOLL_S = 30.0
 
+# Consecutive wedged-journal probes before /healthz flips the journal
+# component unhealthy. One failed non-blocking flock probe is ordinary
+# contention with a fenced commit or a compaction; several in a row (at the
+# ~1s health cadence) means a holder died or stalled with the lock held —
+# the wedge /healthz exists to surface.
+_JOURNAL_WEDGE_POLLS = 3
+
+# Cadence of the health push + scheduler gauges while the phase loop runs.
+_HEALTH_PUSH_S = 1.0
+
 # Registered phase runners, by name so the spawn pickling stays trivial.
 # Each maps (case_study_obj, [model_id], kwargs) -> None and must itself be
 # single-process (num_workers forced to 1 inside the worker).
@@ -390,6 +400,16 @@ def run_phase_parallel(
     # inherits the parent environment) appends into the SAME run directory
     # and the streams merge across the spawn boundary.
     obs.enabled()
+    # Live telemetry plane (obs v4): serve /healthz + /metrics from THIS
+    # process while the phase runs. No-op unless TIP_OBS_HTTP is set. The
+    # endpoint handlers read only in-memory state, so every filesystem-
+    # backed health input (breaker state file, journal flock) is polled
+    # HERE, on the scheduler loop's cadence, and pushed in.
+    from simple_tip_tpu.obs import exporter
+    from simple_tip_tpu.resilience.breaker import CircuitBreaker
+
+    http_port = exporter.start()
+    health_breaker = CircuitBreaker.from_env() if http_port is not None else None
     # Admission control (obs v3): quote the cost model's wall-clock estimate
     # for this phase before launching, and stamp predicted_s next to the
     # span's eventual actual_s so every executed study grades (and feeds)
@@ -495,6 +515,38 @@ def run_phase_parallel(
             and m not in done_elsewhere
             and m not in failed_elsewhere
         ]
+
+    _wedge_polls = [0]  # consecutive wedged-journal probes (debounced)
+
+    def _push_health() -> None:
+        """Poll the filesystem-backed health inputs and push them into the
+        exporter, plus the live scheduler gauges. Runs on the scheduler
+        loop (``_HEALTH_PUSH_S`` cadence) so HTTP handler threads never
+        touch the breaker state file or the journal flock themselves."""
+        if http_port is None:
+            return
+        if health_breaker is not None:
+            exporter.set_health(
+                "breaker",
+                ok=health_breaker.healthy(),
+                **health_breaker.snapshot(),
+            )
+        if journal is not None:
+            _wedge_polls[0] = _wedge_polls[0] + 1 if journal.wedged() else 0
+            exporter.set_health(
+                "journal",
+                ok=_wedge_polls[0] < _JOURNAL_WEDGE_POLLS,
+                wedged_polls=_wedge_polls[0],
+                path=journal.path,
+            )
+        outstanding = len(_outstanding())
+        exporter.set_health(
+            "scheduler", ok=True, phase=phase, case_study=case_study,
+            outstanding=outstanding, in_flight=len(in_flight),
+            workers_alive=sum(1 for w in workers if w.is_alive()),
+        )
+        obs.gauge("scheduler.in_flight").set(len(in_flight))
+        obs.gauge("scheduler.outstanding").set(outstanding)
 
     def _fleet_tick() -> None:
         """One fleet housekeeping pass: heartbeat + coordinator duties,
@@ -709,9 +761,14 @@ def run_phase_parallel(
     startup_rescued = False
     mempoll_s = float(os.environ.get("TIP_OBS_MEMPOLL_S", str(_DEFAULT_MEMPOLL_S)))
     last_mempoll = time.monotonic()
+    _push_health()  # seed /healthz before the first loop iteration
+    last_health = time.monotonic()
 
     while _outstanding():
         _fleet_tick()
+        if http_port is not None and time.monotonic() - last_health >= _HEALTH_PUSH_S:
+            last_health = time.monotonic()
+            _push_health()
         if (
             mempoll_s > 0
             and obs.enabled()
@@ -802,6 +859,7 @@ def run_phase_parallel(
     if obs.enabled():
         obs.record_device_memory()
     obs.flush_metrics()
+    _push_health()  # terminal state: outstanding=0 (or the failure counts)
 
     failed = {m: e for m, e in results.items() if e is not None}
     failed.update(failed_elsewhere)

@@ -201,6 +201,14 @@ class CircuitBreaker:
         else:
             self._store({"state": CLOSED, "failures": failures})
 
+    def healthy(self) -> bool:
+        """Health-plane verdict (the exporter's ``/healthz`` input): False
+        exactly while the breaker is OPEN in its cooldown window — the
+        state where probes short-circuit and callers degrade. Half-open
+        counts as healthy: a test probe is allowed through, which is the
+        recovery path an operator wants 200 to reflect."""
+        return self.state() != OPEN
+
     def snapshot(self) -> Dict:
         """JSON-safe view for bench records / diagnostics."""
         st = self._load()

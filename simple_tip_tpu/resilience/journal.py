@@ -152,6 +152,34 @@ class RunJournal:
 
         return _Lock()
 
+    def wedged(self) -> bool:
+        """Non-blocking probe: is the journal flock held right now?
+
+        The health-plane input behind ``/healthz``'s "journal wedged"
+        verdict. The flock is held only for the microseconds of a fenced
+        append or a compaction rename, so one True is ordinary contention
+        — but a holder that died or stalled with the fd open (the wedge
+        failure mode this deployment actually sees) keeps the lock held
+        across every probe. Publishers debounce: the scheduler flags the
+        journal unhealthy only after several consecutive True polls.
+        Never raises; no fcntl (non-POSIX) means never wedged.
+        """
+        if fcntl is None:
+            return False
+        try:
+            fd = os.open(self.path + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
+        except OSError:
+            return False
+        try:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                return True
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            return False
+        finally:
+            os.close(fd)
+
     # -- writing -----------------------------------------------------------
 
     def mark_done(self, model_id, fence=None) -> None:

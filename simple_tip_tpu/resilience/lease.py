@@ -395,14 +395,20 @@ class Membership:
             logger.info("fleet member %s left", self.host_id)
         self._joined = False
 
-    def alive(self) -> Dict[str, Dict]:
-        """host_id -> heartbeat record, for hosts beating within the TTL."""
+    def table(self) -> Dict[str, Dict]:
+        """host_id -> last heartbeat record, stale hosts INCLUDED.
+
+        ``alive()`` is the membership *decision* (TTL-filtered); this is
+        the operator *view* behind the exporter's ``/fleet`` route — a
+        host that stopped beating must show up with its heartbeat age so
+        the coordinator can mark it ``stale=true``, not silently vanish
+        from the table.
+        """
         out: Dict[str, Dict] = {}
         try:
             names = os.listdir(self.root)
         except OSError:
             return out
-        now = fleet_now()
         for name in names:
             if not (name.startswith("member_") and name.endswith(".json")):
                 continue
@@ -411,6 +417,15 @@ class Membership:
                     rec = json.load(f)
             except (OSError, ValueError):
                 continue
-            if isinstance(rec, dict) and now - float(rec.get("ts", 0)) <= self.ttl_s:
-                out[str(rec.get("host"))] = rec
+            if isinstance(rec, dict) and rec.get("host") is not None:
+                out[str(rec["host"])] = rec
         return out
+
+    def alive(self) -> Dict[str, Dict]:
+        """host_id -> heartbeat record, for hosts beating within the TTL."""
+        now = fleet_now()
+        return {
+            host: rec
+            for host, rec in self.table().items()
+            if now - float(rec.get("ts", 0)) <= self.ttl_s
+        }

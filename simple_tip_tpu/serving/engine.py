@@ -127,6 +127,15 @@ class ScoringEngine:
         self._inflight = asyncio.Semaphore(self.knobs.max_inflight)
         self._task = asyncio.get_running_loop().create_task(self._run())
         self._task.add_done_callback(self._on_scheduler_done)
+        # Live telemetry plane (obs v4): expose /slo (and /healthz//metrics)
+        # while the engine serves. No-op unless TIP_OBS_HTTP is set.
+        # slo_snapshot() reads only the in-memory metrics registry (atomic
+        # copy-under-lock) + batcher/knob state, so it is handler-safe.
+        from simple_tip_tpu.obs import exporter
+
+        if exporter.start() is not None:
+            exporter.set_provider("slo", self.slo_snapshot)
+            exporter.set_health("serving", ok=True)
 
     async def __aenter__(self) -> "ScoringEngine":
         """Async-context entry: start the scheduler."""
@@ -147,6 +156,12 @@ class ScoringEngine:
         if self._closed:
             return
         self._closed = True
+        from simple_tip_tpu.obs import exporter
+
+        if exporter.enabled():
+            # Unhook /slo: a closed engine's snapshot would read as live.
+            exporter.clear_provider("slo")
+            exporter.clear_health("serving")
         if self._task is not None:
             self._wake.set()
             try:
@@ -294,6 +309,11 @@ class ScoringEngine:
         logger.error("serving scheduler task died: %r", exc)
         obs.counter("serving.scheduler_crashes").inc()
         obs.event("serving.scheduler_crash", error=repr(exc)[:200])
+        from simple_tip_tpu.obs import exporter
+
+        if exporter.enabled():
+            # Flip /healthz to 503: the engine can no longer serve.
+            exporter.set_health("serving", ok=False, error=repr(exc)[:200])
         self._closed = True
         for chunk in self.batcher.drain():
             chunk.request.fail(EngineClosed(f"scheduler task died: {exc!r}"))
@@ -401,7 +421,16 @@ class ScoringEngine:
     # -- introspection -------------------------------------------------------
 
     def slo_snapshot(self) -> dict:
-        """JSON-safe serving SLO view (the dashboard read in RUNBOOK §8)."""
+        """JSON-safe serving SLO view (RUNBOOK §8's dashboard read; the
+        exporter's ``/slo`` route).
+
+        Safe to call from any thread at any time, including the exporter's
+        HTTP handler threads while dispatches are landing latencies
+        concurrently: ``obs.metrics_snapshot()`` copies the registry under
+        its lock in one critical section, so the quantile summaries here
+        are a coherent point-in-time view (p50 <= p95 <= p99 always holds
+        within one window), and the engine reads touch no filesystem.
+        """
         snap = obs.metrics_snapshot()
         counters = snap.get("counters", {})
         quantiles = snap.get("quantiles", {})

@@ -18,8 +18,13 @@ import sys
 # no-op overhead pin); tests that need telemetry enable it per-test via
 # monkeypatch + obs.reset_all(). Same for an inherited study-root pin and
 # the v2 lifecycle knobs, which would silently re-parent / sample / rotate
-# every span the suite writes.
-for _var in ("TIP_OBS_DIR", "TIP_OBS_ROOT", "TIP_OBS_SAMPLE", "TIP_OBS_MAX_BYTES"):
+# every span the suite writes — and for TIP_OBS_HTTP, which would bind a
+# live /metrics server (fighting over the port across workers) under
+# every scheduler/serving test in the suite.
+for _var in (
+    "TIP_OBS_DIR", "TIP_OBS_ROOT", "TIP_OBS_SAMPLE", "TIP_OBS_MAX_BYTES",
+    "TIP_OBS_HTTP",
+):
     os.environ.pop(_var, None)
 
 # An inherited fault plan (a developer mid-chaos-debug, a CI job that

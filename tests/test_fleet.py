@@ -217,6 +217,74 @@ def test_two_contexts_partition_units_disjointly(tmp_path, monkeypatch):
     assert won["hA"] and won["hB"]
 
 
+def test_fleet_view_marks_stale_heartbeat_and_recovers(tmp_path, monkeypatch):
+    """Obs v4 satellite: a host whose heartbeats stop (chaos seam
+    ``heartbeat.drop``) must show ``stale: true`` in the coordinator's
+    /fleet view — while staying IN the view, unlike ``alive()`` which
+    TTL-filters it — and must read fresh again after it rejoins."""
+    monkeypatch.setenv("TIP_JOURNAL", str(tmp_path / "runs.jsonl"))
+    root = str(tmp_path / "fleet")
+    ctx = FleetContext(root, "h0", "cs", "ph",
+                       lease_ttl_s=30.0, member_ttl_s=0.5)
+    other = Membership(os.path.join(root, "members"), "h1", ttl_s=0.5)
+    assert ctx.members.beat() is True
+    assert other.beat() is True
+    view = ctx.fleet_view()
+    assert view["host"] == "h0" and view["member_ttl_s"] == 0.5
+    assert view["members"]["h1"]["stale"] is False
+
+    # Partition h1: its beats drop on the floor (times: 0 = every beat).
+    monkeypatch.setenv("TIP_FAULT_STATE", str(tmp_path / "fstate"))
+    monkeypatch.setenv("TIP_FAULT_PLAN", json.dumps({"faults": [
+        {"site": "heartbeat.drop", "kind": "fail",
+         "match": {"host": "h1"}, "times": 0},
+    ]}))
+    assert other.beat() is False
+    time.sleep(0.6)  # h1's last landed beat ages past the 0.5s TTL
+    assert ctx.members.beat() is True  # h0 keeps beating through it
+    view = ctx.fleet_view()
+    assert view["members"]["h0"]["stale"] is False
+    assert view["members"]["h1"]["stale"] is True, (
+        "a partitioned host must surface as stale, not vanish"
+    )
+    assert view["members"]["h1"]["age_s"] > 0.5
+    # the cached copy the exporter serves is the same object, no bus walk
+    assert ctx.last_fleet_view() is view
+
+    # Rejoin: the fault plan lifts, h1 beats, staleness clears.
+    monkeypatch.delenv("TIP_FAULT_PLAN")
+    assert other.beat() is True
+    view = ctx.fleet_view()
+    assert view["members"]["h1"]["stale"] is False
+    assert view["members"]["h1"]["age_s"] < 0.5
+
+
+def test_fleet_view_reports_coordinator_and_straggler_leases(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("TIP_JOURNAL", str(tmp_path / "runs.jsonl"))
+    monkeypatch.setenv("TIP_FLEET_STRAGGLER_S", "100.0")
+    root = str(tmp_path / "fleet")
+    ctx = FleetContext(root, "h0", "cs", "ph",
+                       lease_ttl_s=200.0, member_ttl_s=5.0)
+    ctx.tick()  # beats + takes the coordinator lease + refreshes the view
+    assert ctx.try_claim(7) is not None
+    view = ctx.fleet_view()
+    assert view["is_coordinator"] is True
+    assert view["coordinator"]["owner"] == "h0"
+    assert view["coordinator"]["epoch"] >= 1
+    (lease,) = view["leases"]
+    assert lease["unit"] == "7" and lease["verdict"] == "ok"
+    assert view["in_flight"] == 1
+    # age a lease past the straggler timeout: the verdict must flip
+    monkeypatch.setattr(
+        "simple_tip_tpu.parallel.fleet.fleet_now",
+        lambda: time.time() + 150.0,
+    )
+    (lease,) = ctx.fleet_view()["leases"]
+    assert lease["verdict"] == "straggler"
+
+
 def test_fleet_attempt_budget_exhausts_across_hosts(tmp_path, monkeypatch):
     monkeypatch.setenv("TIP_JOURNAL", str(tmp_path / "runs.jsonl"))
     monkeypatch.setenv("TIP_RETRY_FLEET_ATTEMPTS", "2")

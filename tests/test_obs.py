@@ -1066,6 +1066,21 @@ def test_predict_cli_states_error_and_exit_codes(tmp_path, capsys):
         ["predict", "--phases", "sa_fit.total", "--index",
          str(tmp_path / "void")]
     ) == 3
+    # --json contract on the empty-index exit-3 path: stdout must still be
+    # ONE machine-parseable document (diagnostics ride stderr), so a
+    # pipeline doing `obs predict --json | jq` never chokes on prose.
+    capsys.readouterr()
+    rc = main(
+        ["predict", "--phases", "sa_fit.total", "--json", "--index",
+         str(tmp_path / "void")]
+    )
+    captured = capsys.readouterr()
+    assert rc == 3
+    doc = json.loads(captured.out)
+    assert doc["ok"] is False
+    assert doc["error"] == "insufficient_corpus"
+    assert doc["phases"] == {} and doc["total_s"] is None
+    assert "corpus" in captured.err  # the human note stays on stderr
     # corpus exists but no requested phase does: exit 3 with the loud note
     rc = main(["predict", "--phases", "never_ran", "--index", idx, "--json"])
     assert rc == 3
@@ -1239,3 +1254,379 @@ def test_store_multichip_stamp_marks_degraded_rows(tmp_path):
     assert rows["MULTICHIP_r04.json"]["degraded"] is True, (
         "explicit driver-composed keys win without a stamp"
     )
+
+
+# --- obs v4: live telemetry plane (exporter, live tail/top, plan audit) ------
+
+import io  # noqa: E402
+import re  # noqa: E402
+import urllib.error  # noqa: E402
+import urllib.request  # noqa: E402
+
+from simple_tip_tpu.obs import exporter, live  # noqa: E402
+
+AUDIT_FIXTURE = os.path.join(REPO_ROOT, "tests", "fixtures", "obs_audit")
+
+
+def _audit_runs(*names):
+    return [os.path.join(AUDIT_FIXTURE, n) for n in names]
+
+
+def _get(port, path):
+    url = f"http://127.0.0.1:{port}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+@pytest.fixture
+def http_exporter(monkeypatch):
+    """A live exporter on an ephemeral port (clean registry, reset after)."""
+    monkeypatch.setenv("TIP_OBS_HTTP", "auto")
+    obs.reset_all()
+    port = exporter.start()
+    assert port is not None
+    yield port
+    obs.reset_all()
+
+
+def test_exporter_is_noop_when_unset(monkeypatch):
+    """The TIP_OBS_DIR contract, mirrored: unset knob => no server, no
+    thread, no port — start() is a cheap refusal the mounts can call
+    unconditionally."""
+    monkeypatch.delenv("TIP_OBS_HTTP", raising=False)
+    exporter.reset()
+    assert exporter.start() is None
+    assert exporter.enabled() is False
+    assert exporter.bound_port() is None
+    for raw in ("0", "off", "", "not-a-port", "99999999"):
+        monkeypatch.setenv("TIP_OBS_HTTP", raw)
+        assert exporter.start() is None, raw
+
+
+def test_exporter_start_is_idempotent(http_exporter):
+    assert exporter.start() == http_exporter
+    assert exporter.bound_port() == http_exporter
+
+
+def test_healthz_flips_200_503_200_with_component_health(http_exporter):
+    status, body = _get(http_exporter, "/healthz")
+    doc = json.loads(body)
+    assert status == 200 and doc["ok"] is True and doc["pid"] == os.getpid()
+    exporter.set_health("breaker", ok=False, state="open", failures=3)
+    status, body = _get(http_exporter, "/healthz")
+    doc = json.loads(body)
+    assert status == 503 and doc["ok"] is False
+    assert doc["components"]["breaker"]["state"] == "open"
+    exporter.set_health("breaker", ok=True, state="closed")
+    status, _ = _get(http_exporter, "/healthz")
+    assert status == 200
+    exporter.clear_health("breaker")
+    assert "breaker" not in json.loads(_get(http_exporter, "/healthz")[1])[
+        "components"
+    ]
+
+
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"[-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|[0-9]+)$"
+)
+_PROM_COMMENT = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+
+
+def test_metrics_route_is_valid_prometheus_text(http_exporter):
+    obs.counter("live.hits").inc(2)
+    obs.gauge("live.queue").set(5)
+    obs.histogram("live.batch_s").observe(0.5)
+    for v in (10.0, 20.0, 30.0, 40.0):
+        obs.quantile("live.req_ms").observe(v)
+    exporter.set_health("sched", ok=True)
+    status, text = _get(http_exporter, "/metrics")
+    assert status == 200 and text.endswith("\n")
+    for line in text.splitlines():
+        if line:
+            assert _PROM_COMMENT.match(line) or _PROM_SAMPLE.match(line), line
+    assert "tip_up 1" in text
+    assert "tip_live_hits_total 2" in text
+    assert "tip_live_queue 5" in text
+    assert "tip_live_batch_s_count 1" in text
+    assert 'tip_live_req_ms{quantile="0.95"}' in text
+    assert 'tip_health_ok{component="sched"} 1' in text
+
+
+def test_provider_routes_serve_clear_and_survive_raises(http_exporter):
+    exporter.set_provider("slo", lambda: {"queue_rows": 3})
+    exporter.set_provider("fleet", lambda: {"members": []})
+    status, body = _get(http_exporter, "/slo")
+    assert status == 200 and json.loads(body)["queue_rows"] == 3
+    status, body = _get(http_exporter, "/fleet")
+    assert status == 200 and json.loads(body)["members"] == []
+    assert _get(http_exporter, "/unknown")[0] == 404
+    exporter.set_provider("slo", lambda: 1 // 0)
+    assert _get(http_exporter, "/slo")[0] == 500
+    assert _get(http_exporter, "/healthz")[0] == 200, (
+        "a raising provider must not take down the server"
+    )
+    exporter.clear_provider("slo")
+    assert _get(http_exporter, "/slo")[0] == 404
+
+
+def test_scheduler_study_serves_health_and_metrics_midphase(
+    tmp_path, monkeypatch
+):
+    """Acceptance: a real scheduler phase with TIP_OBS_HTTP set serves
+    /healthz 200 and grammar-valid /metrics WHILE running (checked from a
+    phase body via the synthetic chaos phase's fault seam-free path)."""
+    from simple_tip_tpu.parallel import run_scheduler
+
+    monkeypatch.setenv("TIP_OBS_DIR", str(tmp_path / "obsrun"))
+    monkeypatch.setenv("TIP_OBS_HTTP", "auto")
+    obs.reset_all()
+    seen = {}
+
+    orig_push = run_scheduler.mp.get_context
+
+    def probing_ctx(method):
+        # First get_context call happens after the exporter mount: probe
+        # the live routes exactly once, mid-setup of the real phase.
+        if "status" not in seen:
+            port = exporter.bound_port()
+            assert port is not None
+            seen["status"], _ = _get(port, "/healthz")
+            _, seen["metrics"] = _get(port, "/metrics")
+        return orig_push(method)
+
+    monkeypatch.setattr(run_scheduler.mp, "get_context", probing_ctx)
+    try:
+        run_scheduler.run_phase_parallel(
+            "mnist",  # registry name; the sleep phase never touches its data
+            "_test_sleep", [0, 1], num_workers=2,
+            phase_kwargs={"seconds": 0.05},
+            worker_platforms=["cpu", "cpu"], run_timeout_s=60.0,
+        )
+    finally:
+        obs.reset_all()
+    assert seen["status"] == 200
+    for line in seen["metrics"].splitlines():
+        if line:
+            assert _PROM_COMMENT.match(line) or _PROM_SAMPLE.match(line), line
+    assert "tip_up 1" in seen["metrics"]
+
+
+def test_healthz_503_when_breaker_open_and_journal_wedged(
+    tmp_path, monkeypatch
+):
+    """The two /healthz failure inputs, end to end: an OPEN breaker and a
+    held journal flock must each flip the verdict to 503."""
+    from simple_tip_tpu.resilience.breaker import CircuitBreaker
+    from simple_tip_tpu.resilience.journal import RunJournal
+
+    monkeypatch.setenv("TIP_OBS_HTTP", "auto")
+    monkeypatch.setenv(
+        "TIP_BREAKER_STATE", str(tmp_path / "breaker_state.json")
+    )
+    monkeypatch.setenv("TIP_BREAKER_THRESHOLD", "1")
+    obs.reset_all()
+    try:
+        port = exporter.start()
+        br = CircuitBreaker.from_env()
+        br.record_failure()  # threshold 1: OPEN
+        assert br.healthy() is False
+        exporter.set_health("breaker", ok=br.healthy(), **br.snapshot())
+        status, body = _get(port, "/healthz")
+        assert status == 503
+        assert json.loads(body)["components"]["breaker"]["state"] == "open"
+
+        jr = RunJournal(str(tmp_path / "runs.jsonl"), "cs", "ph")
+        assert jr.wedged() is False
+        with jr._locked():  # a holder that never lets go == the wedge
+            assert jr.wedged() is True
+            exporter.set_health("journal", ok=not jr.wedged())
+            br.record_success()
+            exporter.set_health("breaker", ok=br.healthy())
+            assert _get(port, "/healthz")[0] == 503
+        assert jr.wedged() is False
+        exporter.set_health("journal", ok=not jr.wedged())
+        assert _get(port, "/healthz")[0] == 200
+    finally:
+        obs.reset_all()
+
+
+def test_stream_cursor_carries_torn_tail_until_newline(tmp_path):
+    p = str(tmp_path / "events-0.jsonl")
+    cur = live.StreamCursor(p)
+    assert cur.poll() == []  # missing file: not an error, just nothing yet
+    with open(p, "w", encoding="utf-8") as f:
+        f.write('{"type": "span", "name": "a", "ts": 1.0}\n')
+        f.write('{"type": "span", "name": "b", "ts"')  # writer mid-append
+    assert [r["name"] for r in cur.poll()] == ["a"]
+    with open(p, "a", encoding="utf-8") as f:
+        f.write(': 2.0}\n{"type": "span", "name": "c", "ts": 3.0}\n')
+    assert [r["name"] for r in cur.poll()] == ["b", "c"]
+    assert cur.bad_lines == 0
+    with open(p, "a", encoding="utf-8") as f:
+        f.write("not json at all\n")
+    assert cur.poll() == [] and cur.bad_lines == 1
+
+
+def test_tail_merges_streams_and_aligns_clock(tmp_path):
+    d = tmp_path / "run"
+    d.mkdir()
+    (d / "events-0.jsonl").write_text(
+        '{"type": "meta", "ts": 100.0, "pid": 1}\n'
+        '{"type": "span", "name": "late", "ts": 102.5, "dur": 1.0, "pid": 1}\n'
+    )
+    (d / "events-1.jsonl").write_text(
+        '{"type": "event", "name": "mid", "ts": 101.0, "pid": 2,'
+        ' "attrs": {"k": 1}}\n'
+    )
+    buf = io.StringIO()
+    assert live.tail(str(d), out=buf) == 0
+    lines = buf.getvalue().splitlines()
+    assert len(lines) == 3
+    assert "+    0.000s" in lines[0]  # aligned to the earliest ts
+    assert "mid" in lines[1] and '{"k": 1}' in lines[1]
+    assert "late" in lines[2] and "dur=1.000s" in lines[2]
+    # empty target: exit 3 (same contract as predict's thin corpus)
+    empty = tmp_path / "void"
+    empty.mkdir()
+    assert live.tail(str(empty), out=io.StringIO()) == 3
+
+
+def test_tail_follow_picks_up_live_appends_and_new_files(tmp_path):
+    d = tmp_path / "run"
+    d.mkdir()
+    (d / "events-0.jsonl").write_text('{"type": "meta", "ts": 1.0, "pid": 1}\n')
+
+    import threading
+
+    def writer():
+        time.sleep(0.15)
+        with open(d / "events-0.jsonl", "a", encoding="utf-8") as f:
+            f.write('{"type": "event", "name": "n1", "ts": 2.0, "pid": 1}\n')
+        # a worker spawning mid-phase: a NEW stream joins the merge
+        (d / "events-9.jsonl").write_text(
+            '{"type": "event", "name": "n2", "ts": 3.0, "pid": 9}\n'
+        )
+
+    t = threading.Thread(target=writer)
+    t.start()
+    got = [
+        r["name"] if r.get("name") else r["type"]
+        for r in live.iter_tail(
+            str(d), follow=True, poll_s=0.05, duration_s=2.0, max_events=3
+        )
+    ]
+    t.join()
+    assert got == ["meta", "n1", "n2"]
+
+
+def test_top_snapshot_counts_lifecycle_and_queue(tmp_path):
+    events = [
+        {"type": "span", "name": "scheduler.phase",
+         "attrs": {"phase": "sa_fit", "runs": 4}},
+        {"type": "event", "name": "scheduler.announce",
+         "attrs": {"phase": "sa_fit"}},
+        {"type": "event", "name": "scheduler.announce",
+         "attrs": {"phase": "sa_fit"}},
+        {"type": "event", "name": "scheduler.start",
+         "attrs": {"phase": "sa_fit"}},
+        {"type": "event", "name": "scheduler.done",
+         "attrs": {"phase": "sa_fit"}},
+        {"type": "event", "name": "scheduler.requeue",
+         "attrs": {"phase": "sa_fit"}},
+        {"type": "metrics", "gauges": {"scheduler.in_flight": 1.0}},
+    ]
+    snap = live.top_snapshot(events)
+    b = snap["phases"]["sa_fit"]
+    assert b["announced"] == 2 and b["done"] == 1 and b["queue"] == 1
+    assert b["requeued"] == 1 and b["expected"] == 4
+    assert snap["gauges"]["scheduler.in_flight"] == 1.0
+    table = live.render_top(snap)
+    assert "sa_fit" in table and "2/4" in table
+    assert "scheduler.in_flight" in table
+
+
+def test_top_cli_one_shot_renders_fixture(capsys):
+    assert main(["top", os.path.join(AUDIT_FIXTURE, "run1"), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "phase" in out and "sa_fit" in out
+
+
+def test_audit_grades_fixture_and_emits_trend_snapshot(capsys):
+    assert main(["audit", os.path.join(AUDIT_FIXTURE, "run1")]) == 0
+    out = capsys.readouterr().out
+    assert "sa_fit" in out and "test_prio" in out
+    assert main(
+        ["audit", os.path.join(AUDIT_FIXTURE, "run1"), "--json"]
+    ) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["kind"] == "audit"
+    assert doc["phases"]["audit.sa_fit"] == pytest.approx(0.18)
+    assert doc["by_phase"]["test_prio"]["bias_s"] == pytest.approx(-0.20)
+    assert [s["phase"] for s in doc["spans"]] == ["sa_fit", "test_prio"]
+
+
+def test_audit_exit_codes_no_streams_and_no_pairs(tmp_path, capsys):
+    assert main(["audit", str(tmp_path / "void")]) == 2
+    bare = tmp_path / "bare"
+    bare.mkdir()
+    (bare / "events-0.jsonl").write_text(
+        '{"type": "span", "name": "training", "ts": 1.0, "dur": 2.0,'
+        ' "pid": 1}\n'
+    )
+    capsys.readouterr()
+    assert main(["audit", str(bare)]) == 3
+    err = capsys.readouterr().err
+    assert "predicted_s" in err
+
+
+def test_audit_snapshots_gate_cost_model_drift_via_trend(tmp_path, capsys):
+    """The closed loop the tentpole exists for: audit --json docs from the
+    stable fixture runs pass `obs trend`, and the drifted run5 (a ~5s
+    cost-model error vs the ~0.2s baseline) FAILS it."""
+    snaps = []
+    for run in ("run1", "run2", "run3", "run4", "run5"):
+        capsys.readouterr()
+        assert main(["audit", os.path.join(AUDIT_FIXTURE, run), "--json"]) == 0
+        p = tmp_path / f"{run}.json"
+        p.write_text(capsys.readouterr().out)
+        snaps.append(str(p))
+    assert main(["trend", *snaps[:4]]) == 0
+    capsys.readouterr()
+    assert main(["trend", *snaps]) == 1
+    out = capsys.readouterr().out
+    assert "audit.sa_fit" in out and "REGRESSED" in out
+
+
+def test_audit_index_lands_error_rows_in_feature_store(tmp_path, capsys):
+    from simple_tip_tpu.obs import store
+
+    idx = str(tmp_path / "index")
+    assert main(
+        ["audit", *_audit_runs("run1", "run2"), "--index", idx]
+    ) == 0
+    capsys.readouterr()
+    rows = [r for r in store.load_rows(idx) if r["phase"].startswith("audit.")]
+    assert {r["phase"] for r in rows} == {"audit.sa_fit", "audit.test_prio"}
+    sa = sorted(
+        (r for r in rows if r["phase"] == "audit.sa_fit"),
+        key=lambda r: r["seconds"],
+    )
+    # seconds = absolute error; value = signed relative error
+    assert sa[0]["seconds"] == pytest.approx(0.18)
+    assert sa[0]["value"] == pytest.approx(0.003)
+    assert sa[1]["seconds"] == pytest.approx(0.22)
+    assert sa[1]["value"] == pytest.approx(-0.003667, rel=1e-3)
+
+
+def test_scheduler_phase_spans_feed_audit_live(obs_dir):
+    """A real span with predicted_s+actual_s lands in the live audit."""
+    with obs.span(
+        "scheduler.phase", phase="sa_fit", predicted_s=10.0
+    ) as sp:
+        sp.set(actual_s=10.5)
+    doc = live.audit_events(_events(obs_dir))
+    assert doc["phases"] == {"audit.sa_fit": pytest.approx(0.5)}

@@ -8,6 +8,7 @@ tiny chain program and pins that request coalescing cannot change scores.
 """
 
 import asyncio
+import time
 
 import numpy as np
 import pytest
@@ -406,6 +407,60 @@ def test_engine_slo_snapshot_shape():
         assert snap["knobs"]["max_badge"] == 4
 
     run(scenario())
+
+
+def test_slo_snapshot_is_atomic_under_concurrent_writers():
+    """Satellite contract (obs v4): slo_snapshot() must be safe to call
+    from the exporter's HTTP handler threads WHILE dispatches land
+    latencies. The registry snapshot copies everything in one
+    critical section, so each observed quantile summary is coherent:
+    p50 <= p95 <= p99 within a window, counts never go backwards, and
+    no reader ever crashes on a half-updated ring."""
+    import threading
+
+    eng = ScoringEngine(None)  # executor only matters at dispatch time
+    stop = threading.Event()
+    errors = []
+
+    def writer(seed):
+        i = 0
+        while not stop.is_set():
+            obs.quantile("serving.request_ms").observe(float((seed + i) % 97))
+            obs.quantile("serving.badge_ms").observe(float((seed * i) % 53))
+            obs.counter("serving.rows").inc()
+            i += 1
+
+    def reader():
+        last_count = 0
+        while not stop.is_set():
+            try:
+                snap = eng.slo_snapshot()
+            except Exception as e:  # noqa: BLE001 — the failure under test
+                errors.append(repr(e))
+                return
+            for key in ("request_ms", "badge_ms"):
+                q = snap[key]
+                if q is None or not q["count"]:
+                    continue
+                if not (q["p50"] <= q["p95"] <= q["p99"]):
+                    errors.append(f"incoherent {key}: {q}")
+                    return
+            if snap["rows"] < last_count:
+                errors.append(f"rows went backwards: {snap['rows']}")
+                return
+            last_count = snap["rows"]
+
+    threads = [threading.Thread(target=writer, args=(s,)) for s in (3, 7)]
+    threads += [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors
+    final = eng.slo_snapshot()
+    assert final["request_ms"]["count"] > 0
 
 
 def test_shared_loop_drives_engine_from_sync_code():

@@ -970,6 +970,81 @@ async def probe(fut):
 ''',
 }
 
+BAD_BLOCKING_ENDPOINT = {
+    "obs/httpd.py": '''"""m."""
+import http.server
+import json
+import os
+import time
+
+
+class StatsHandler(http.server.BaseHTTPRequestHandler):
+    """Handler that re-derives state per request instead of serving pushes."""
+
+    def do_GET(self):
+        """Walking the obs dir per scrape multiplies disk IO by request rate."""
+        names = os.listdir("/tmp/obs")
+        with open(names[0]) as fh:
+            body = fh.read()
+        self.wfile.write(body.encode())
+
+    def _settle(self):
+        """Sibling helpers of a handler class run on the same thread."""
+        time.sleep(0.5)
+''',
+    "obs/duck_handler.py": '''"""m."""
+import subprocess
+
+
+class Probe:
+    """No HTTPRequestHandler base, but do_* methods mark it as a handler."""
+
+    def do_POST(self):
+        """Shelling out per request is the slow path by construction."""
+        subprocess.run(["df", "-h"], check=False)
+''',
+}
+
+GOOD_BLOCKING_ENDPOINT = {
+    "obs/httpd.py": '''"""m."""
+import http.server
+import json
+
+_STATE = {"ok": True}
+
+
+class StatsHandler(http.server.BaseHTTPRequestHandler):
+    """Push-model handler: serves only state the owning loop pushed in."""
+
+    def do_GET(self):
+        """Reads the in-memory dict; no disk, no sleep, no device work."""
+        body = json.dumps(_STATE).encode()
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_HEAD(self):
+        """Nested defs execute on whoever calls them, not per-request."""
+
+        def refresh():
+            """r."""
+            with open("/tmp/obs/state.json") as fh:
+                _STATE.update(json.loads(fh.read()))
+
+        self.send_response(200)
+        self.end_headers()
+''',
+    # A smoke script's throwaway handler may read fixtures directly.
+    "scripts/probe_server.py": '''"""m."""
+import os
+
+
+class FixtureHandler:
+    def do_GET(self):
+        os.listdir("/tmp/fixtures")
+''',
+}
+
 FIXTURES = {
     "jit-purity": (BAD_JIT_PURITY, GOOD_JIT_PURITY),
     "retrace-risk": (BAD_RETRACE_RISK, GOOD_RETRACE_RISK),
@@ -989,6 +1064,7 @@ FIXTURES = {
     "unfenced-claim": (BAD_UNFENCED_CLAIM, GOOD_UNFENCED_CLAIM),
     "unversioned-schema": (BAD_UNVERSIONED_SCHEMA, GOOD_UNVERSIONED_SCHEMA),
     "blocking-in-async": (BAD_BLOCKING_ASYNC, GOOD_BLOCKING_ASYNC),
+    "blocking-endpoint": (BAD_BLOCKING_ENDPOINT, GOOD_BLOCKING_ENDPOINT),
 }
 
 
@@ -1018,6 +1094,21 @@ def test_jit_purity_finds_each_sin(tmp_path):
     findings = _run_rule(tmp_path, "jit-purity", BAD_JIT_PURITY)
     blob = " ".join(f.message for f in findings)
     for marker in ("print()", "numpy.square", "float()", ".item()", "jax.debug.print"):
+        assert marker in blob, f"missing {marker!r} in: {blob}"
+
+
+def test_blocking_endpoint_names_each_sin_and_method(tmp_path):
+    findings = _run_rule(tmp_path, "blocking-endpoint", BAD_BLOCKING_ENDPOINT)
+    blob = " ".join(f.message for f in findings)
+    for marker in (
+        "os.listdir",
+        "open()",
+        "time.sleep()",
+        "subprocess.run",
+        "StatsHandler.do_GET",
+        "StatsHandler._settle",
+        "Probe.do_POST",
+    ):
         assert marker in blob, f"missing {marker!r} in: {blob}"
 
 
