@@ -100,6 +100,22 @@ ACCEL_CHILD_TIMEOUT_S = float(os.environ.get("TIP_BENCH_ACCEL_TIMEOUT_S", "420")
 CPU_CHILD_TIMEOUT_S = float(os.environ.get("TIP_BENCH_CPU_TIMEOUT_S", "210"))
 
 
+def _plan_stamp() -> str:
+    """The active ExecutionPlan id, else ``"unplanned"``.
+
+    Every record carries the stamp so `obs trend` compares like-for-like
+    plans only (a knob change measures a different configuration, not a
+    regression). Stdlib-only import, failure-safe: the one-JSON-line
+    contract outranks the stamp.
+    """
+    try:
+        from simple_tip_tpu.plan import active_plan_id
+
+        return active_plan_id()
+    except Exception:  # noqa: BLE001 — companion data, never fatal
+        return "unplanned"
+
+
 def _child_measure() -> None:
     """Runs inside the measurement subprocess; prints one JSON line."""
     import numpy as np
@@ -480,6 +496,7 @@ def _child_measure() -> None:
                 "batch": batch,
                 "reps": reps,
                 "platform": platform,
+                "plan": _plan_stamp(),
                 "scored_path": scored_path,
                 **({"fused": fused_info} if fused_info is not None else {}),
                 **(
@@ -619,6 +636,7 @@ def main():
             "baseline": BASELINE_INFO,
             "degraded": True,
             "degraded_reason": "all-attempts-failed",
+            "plan": _plan_stamp(),
             "mfu": 0.0,
             "error": "all measurement attempts failed or timed out",
         }

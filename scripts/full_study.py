@@ -34,9 +34,50 @@ DEFAULT_PHASES = ("training", "test_prio", "active_learning", "evaluation")
 ALL_PHASES = ("training", "test_prio", "active_learning", "at_collection", "evaluation")
 
 
+def _apply_plan(argv) -> "dict | None":
+    """Activate an ExecutionPlan before any knob-reading code runs.
+
+    ``--plan FILE`` (pre-scanned here — argparse runs later, after knob
+    env defaults are already read) or an inherited ``TIP_PLAN_FILE`` both
+    load, validate and export the plan's knob assignment into the
+    environment, so the scheduler workers, the SA fit pool and the serving
+    layer all launch under the planned configuration. The canonical outer
+    path is ``python -m simple_tip_tpu.plan apply plan.json -- python
+    scripts/full_study.py ...`` — this hook makes the inline flag
+    equivalent. Returns the plan doc (or None) for the root-span stamp.
+    """
+    from simple_tip_tpu.plan import PLAN_FILE_ENV, knobs, plan as plan_mod
+
+    path = None
+    for i, arg in enumerate(argv):
+        if arg == "--plan" and i + 1 < len(argv):
+            path = argv[i + 1]
+        elif arg.startswith("--plan="):
+            path = arg.split("=", 1)[1]
+    if path:
+        os.environ[PLAN_FILE_ENV] = os.path.abspath(path)
+    doc = plan_mod.active_plan()
+    if doc is None:
+        if path:
+            raise SystemExit(f"full_study: --plan {path} is not a valid plan")
+        return None
+    os.environ.update(knobs.assignment_env(doc["assignment"]))
+    print(
+        f"plan {doc['plan_id']}: applied "
+        f"{','.join(f'{k}={v}' for k, v in sorted(doc['assignment'].items()))}"
+    )
+    return doc
+
+
 def main() -> int:
     """Run the full prioritization + active-learning study."""
+    active_plan = _apply_plan(sys.argv[1:])
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--plan", default=None,
+        help="ExecutionPlan JSON to run under (see python -m "
+             "simple_tip_tpu.plan); equivalent to launching via `plan apply`",
+    )
     parser.add_argument(
         "--case-studies",
         default="mnist,fmnist,cifar10,imdb",
@@ -201,6 +242,9 @@ def main() -> int:
         runs=len(my_runs),
         host=jax.process_index(),
         **({"predicted_s": predicted_study_s} if predicted_study_s else {}),
+        # The plan id on the root span is what lets `obs audit` grade the
+        # whole study plan-vs-actual and `obs trend` gate planner drift.
+        **({"plan": active_plan["plan_id"]} if active_plan else {}),
     )
     study_span.__enter__()
     study_started = time.perf_counter()

@@ -13,6 +13,7 @@ from simple_tip_tpu.analysis.rules import (  # noqa: F401
     buffer_donation,
     docstring_coverage,
     f64_on_tpu,
+    hardcoded_knob,
     host_sync,
     implicit_transfer,
     jit_purity,

@@ -1,0 +1,126 @@
+"""Rule ``hardcoded-knob``: library code must not pin planner-owned knobs.
+
+The execution planner (``simple_tip_tpu/plan/``) owns the repo's tuning
+surface: every knob in its registry (``plan/knobs.py``,
+``planned_env_vars()``) is searched against the learned cost model, and
+the chosen assignment is applied through an ExecutionPlan. A library
+module that writes one of those env vars into ``os.environ`` directly
+silently overrides whatever the plan chose — invisible to ``plan
+explain``, unattributable in the plan-vs-actual audit, and undiscoverable
+by the next person staring at a study that ignores its plan.
+
+Scripts and tests stay exempt (same surface logic as ``bare-print``):
+entry points and harnesses are exactly where pinning a knob is
+legitimate — the operator IS the override path there.
+
+Flagged write shapes (literal keys only — dynamic keys are plumbing, not
+pins): ``os.environ["TIP_X"] = ...``, ``os.environ.setdefault("TIP_X",
+...)`` and a literal ``"TIP_X"`` key inside ``os.environ.update({...})``.
+``from os import environ`` aliases are resolved.
+"""
+
+import ast
+from typing import Iterator, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.bare_print import _exempt
+
+
+def _knob_envs() -> frozenset:
+    """The planner-owned env vars (imported lazily: the registry lives in
+    the analyzed package, and the analyzer must load even mid-refactor)."""
+    try:
+        from simple_tip_tpu.plan.knobs import planned_env_vars
+
+        return planned_env_vars()
+    except Exception:  # noqa: BLE001 — analyzer availability > one rule
+        return frozenset()
+
+
+def _environ_names(tree) -> set:
+    """Local names bound to ``os.environ`` (``from os import environ [as e]``)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "os":
+            for alias in node.names:
+                if alias.name == "environ":
+                    names.add(alias.asname or "environ")
+    return names
+
+
+def _is_environ(node, environ_names) -> bool:
+    """Whether ``node`` is an expression resolving to ``os.environ``."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return isinstance(node.value, ast.Name) and node.value.id == "os"
+    return isinstance(node, ast.Name) and node.id in environ_names
+
+
+def _literal_knob(node, knob_envs):
+    """The knob env name if ``node`` is a string constant in the registry."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if node.value in knob_envs:
+            return node.value
+    return None
+
+
+@register
+class HardcodedKnobRule(Rule):
+    """Flag library writes of planner-owned TIP_* knobs into os.environ."""
+
+    name = "hardcoded-knob"
+    description = (
+        "library code writes a planner-owned TIP_* tuning knob into "
+        "os.environ; knob assignments must flow through the plan/knobs "
+        "registry (an ExecutionPlan or the operator's shell), not a "
+        "code-level pin (scripts/tests exempt)"
+    )
+
+    def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
+        """Flag literal knob-env writes outside the exempt surfaces."""
+        if _exempt(module):
+            return
+        knob_envs = _knob_envs()
+        if not knob_envs:
+            return
+        environ_names = _environ_names(module.tree)
+
+        def hit(lineno, env):
+            return "", lineno, (
+                f"{env} is a planner-owned tuning knob "
+                f"(simple_tip_tpu/plan/knobs.py) hardcoded into os.environ "
+                f"here: the pin silently overrides any active ExecutionPlan "
+                f"and is invisible to `plan explain` — take the value from "
+                f"the plan (or let the operator's shell set it)"
+            )
+
+        for node in ast.walk(module.tree):
+            # os.environ["TIP_X"] = ...
+            if isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Store
+            ):
+                env = _literal_knob(node.slice, knob_envs)
+                if env and _is_environ(node.value, environ_names):
+                    yield hit(node.lineno, env)
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                # os.environ.setdefault("TIP_X", ...)
+                if (
+                    node.func.attr == "setdefault"
+                    and _is_environ(node.func.value, environ_names)
+                    and node.args
+                ):
+                    env = _literal_knob(node.args[0], knob_envs)
+                    if env:
+                        yield hit(node.lineno, env)
+                # os.environ.update({"TIP_X": ...})
+                elif (
+                    node.func.attr == "update"
+                    and _is_environ(node.func.value, environ_names)
+                ):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Dict):
+                            for key in arg.keys:
+                                env = _literal_knob(key, knob_envs)
+                                if env:
+                                    yield hit(node.lineno, env)

@@ -313,16 +313,34 @@ def estimate_variant_fit_bytes(sa_name: str, n: int, d: int) -> int:
     return base + n * d * 4  # dsa keeps a reference copy for kNN
 
 
+def mem_fraction() -> float:
+    """The FitPool memory bound: fraction of available RAM the fan-out may
+    budget (``TIP_SA_MEM_FRAC``, a planner knob; default 0.5, clamped to
+    (0, 1]; a bad value warns and keeps the default, never crashes)."""
+    raw = os.environ.get("TIP_SA_MEM_FRAC", "").strip()
+    if not raw:
+        return 0.5
+    try:
+        frac = float(raw)
+    except ValueError:
+        logging.getLogger(__name__).warning(
+            "TIP_SA_MEM_FRAC=%r is not a number; using 0.5", raw
+        )
+        return 0.5
+    return min(max(frac, 0.01), 1.0)
+
+
 def fanout_workers(names: Sequence[str], n: int, d: int) -> int:
     """How many whole-variant fits may run at once within the memory budget
-    (half of available RAM; serial when psutil or the budget says no)."""
+    (``mem_fraction()`` of available RAM; serial when psutil or the budget
+    says no)."""
     cap = min(pool_size(), len(names))
     if cap <= 1:
         return 1
     try:
         import psutil
 
-        budget = psutil.virtual_memory().available // 2
+        budget = int(psutil.virtual_memory().available * mem_fraction())
     except Exception:  # noqa: BLE001 — no psutil: trust pool_size alone
         return cap
     per_variant = max(

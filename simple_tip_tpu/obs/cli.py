@@ -502,7 +502,10 @@ def _predict(args) -> int:
     """``obs predict`` entry: fit over the index, estimate study wall-clock."""
     from simple_tip_tpu.obs import costmodel, store
 
-    rows = store.load_rows(args.index or store.default_index_dir())
+    # Shared cached corpus load: the planner (simple_tip_tpu.plan) and
+    # this CLI score against the identical parsed rows, one walk per
+    # index stat instead of one per call.
+    rows = store.load_corpus(args.index or store.default_index_dir())
     if not rows:
         if args.json:
             # The --json contract: stdout ALWAYS carries one valid JSON

@@ -229,7 +229,7 @@ def quick_phase_estimate(
     advisory; a missing estimate must never block a launch.
     """
     try:
-        rows = store.load_rows(index_dir)
+        rows = store.load_corpus(index_dir)
         if not rows:
             return None
         prediction = predict_study(

@@ -96,6 +96,7 @@ def _blank_snapshot(kind: str, source: str) -> dict:
         "counters": {},
         "degraded": None,
         "value": None,
+        "plan": None,
     }
 
 
@@ -107,6 +108,11 @@ def _normalize_bench(doc: dict, source: str) -> dict:
     except (TypeError, ValueError):
         snap["value"] = 0.0
     snap["degraded"] = bool(doc.get("degraded", False))
+    # ExecutionPlan stamp (simple_tip_tpu.plan): records predating the
+    # stamp normalize to "unplanned" — the same value bench.py writes when
+    # no plan is active — so the trend gate's like-for-like filter keeps
+    # the committed history comparable instead of orphaning it.
+    snap["plan"] = str(doc.get("plan") or "unplanned")
     counters = (doc.get("obs_metrics") or {}).get("counters") or {}
     snap["counters"] = {
         k: v for k, v in counters.items() if isinstance(v, (int, float))
@@ -478,6 +484,16 @@ def trend(
         }
     current = snapshots[-1]
     comparable = [s for s in snapshots[:-1] if s.get("degraded") is not True]
+    # Like-for-like plans only: a record measured under ExecutionPlan A is
+    # not a baseline for one measured under plan B (different knob
+    # assignments measure different configurations, not drift). Snapshot
+    # kinds without a plan stamp (host_phase, audit, obs runs) keep the
+    # unfiltered window — their current["plan"] is None.
+    if current.get("plan") is not None:
+        comparable = [
+            s for s in comparable
+            if (s.get("plan") or "unplanned") == current["plan"]
+        ]
     baseline = comparable[-window:]
     if len(baseline) < min_baseline:
         return {

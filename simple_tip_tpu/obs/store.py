@@ -24,7 +24,9 @@ tiplint rule enforces that every obs JSONL writer carries one):
   throughput, higher-is-better);
 - features: ``count``, ``platform``, ``degraded``, ``batch``, ``workers``,
   ``compiles``, ``device_peak_bytes``, ``health`` (summed health counters),
-  ``case_study``, ``captured`` (epoch seconds when the source states one).
+  ``case_study``, ``captured`` (epoch seconds when the source states one),
+  ``plan`` (the ExecutionPlan id the run executed under, ``"unplanned"``
+  when a record says so explicitly, None for sources predating the stamp).
 
 Consumers: ``obs runs`` (the table/JSON reporter in ``obs/cli.py``),
 ``obs/costmodel.py`` (features → phase seconds), and ``obs trend`` when
@@ -171,6 +173,7 @@ def _blank_row(kind: str, source: str, seq: int) -> dict:
         "health": None,
         "case_study": None,
         "captured": None,
+        "plan": None,
     }
 
 
@@ -240,6 +243,7 @@ def _rows_from_obs_run(path: str, seq: int) -> list:
             row["count"] = attrs.get("runs", 1)
             row["workers"] = attrs.get("workers")
             row["case_study"] = attrs.get("case_study")
+            row["plan"] = attrs.get("plan")
             rows.append(stamp(row, rec.get("ts")))
             # Plan-vs-actual audit row (obs v4): when the scheduler stamped
             # a cost-model prediction next to the measured duration, the
@@ -297,6 +301,15 @@ def _rows_from_bench(path: str, seq: int) -> list:
     run = os.path.splitext(os.path.basename(path))[0]
     counters = (doc.get("obs_metrics") or {}).get("counters") or {}
 
+    # Device-memory high-water: bench.py calls record_device_memory()
+    # before snapshotting metrics, so the gauges carry the same
+    # ``.peak_bytes_in_use`` series obs run dirs do — parsed here so
+    # committed bench records can train the planner's memory model.
+    peak = None
+    for name, v in ((doc.get("obs_metrics") or {}).get("gauges") or {}).items():
+        if name.endswith(".peak_bytes_in_use") and isinstance(v, (int, float)):
+            peak = max(peak or 0, int(v))
+
     def base():
         row = _blank_row("bench", path, seq)
         row["run"] = run
@@ -306,6 +319,8 @@ def _rows_from_bench(path: str, seq: int) -> list:
         row["compiles"] = counters.get("jax.compiles")
         row["health"] = _health_sum(counters)
         row["captured"] = doc.get("captured_unix")
+        row["device_peak_bytes"] = peak
+        row["plan"] = doc.get("plan")
         return row
 
     rows = []
@@ -581,6 +596,37 @@ def load_rows(index_dir=None) -> list:
     live = [r for r in rows if int(r.get("seq", 0)) == latest_seq[r.get("source")]]
     live.sort(key=lambda r: (int(r.get("seq", 0)), str(r.get("phase")), str(r.get("run"))))
     return live
+
+
+#: ``rows_path -> ((path, mtime_ns, size), rows)``: one cached corpus per
+#: index file, invalidated by stat. The planner scores hundreds of
+#: candidates and the obs CLI predicts in the same process — both read
+#: through here instead of re-walking the JSONL per call.
+_corpus_cache: dict = {}
+
+
+def load_corpus(index_dir=None) -> list:
+    """``load_rows`` with a stat-keyed cache (treat the result read-only).
+
+    The planner (``plan/search.py``), ``obs predict`` and
+    ``costmodel.quick_phase_estimate`` all share one parse of the index
+    per (mtime, size); a ``refresh`` that appends rows changes the stat
+    and invalidates naturally. Callers must not mutate the returned list.
+    """
+    index_dir = os.path.abspath(index_dir or default_index_dir())
+    rows_path, _ = _index_paths(index_dir)
+    try:
+        st = os.stat(rows_path)
+    except OSError:
+        _corpus_cache.pop(rows_path, None)
+        return []
+    key = (rows_path, st.st_mtime_ns, st.st_size)
+    cached = _corpus_cache.get(rows_path)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    rows = load_rows(index_dir)
+    _corpus_cache[rows_path] = (key, rows)
+    return rows
 
 
 def render_rows(rows, limit=None) -> str:

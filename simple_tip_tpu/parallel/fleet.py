@@ -240,9 +240,17 @@ class FleetContext:
                 logger.warning("TIP_FLEET_STRAGGLER_S=%r is not a number", raw)
         else:
             try:
+                # Plan first: speculation sized from the same per-phase
+                # prediction the planner committed to (and `obs audit`
+                # grades), falling back to the live cost model.
+                from simple_tip_tpu import plan as _plan
                 from simple_tip_tpu.obs import costmodel
 
-                est = costmodel.quick_phase_estimate(self.phase, 1, workers=1)
+                est = _plan.phase_estimate(self.phase, 1, workers=1)
+                if est is None:
+                    est = costmodel.quick_phase_estimate(
+                        self.phase, 1, workers=1
+                    )
             except Exception:  # noqa: BLE001 — advisory, never fatal
                 est = None
             if est is not None:

@@ -416,16 +416,29 @@ def run_phase_parallel(
     # the corpus. Advisory by contract — no index, no estimate, no change.
     from simple_tip_tpu.obs import costmodel as _costmodel
 
-    estimate = _costmodel.quick_phase_estimate(
-        phase, len(pending), workers=num_workers
-    )
+    # An active ExecutionPlan outranks the live fit: its stored per-phase
+    # prediction is what the planner chose the knobs AGAINST, so stamping
+    # it as predicted_s makes `obs audit` grade the PLAN, not a fresher
+    # model the plan never saw. The plan id rides the span for the same
+    # reason — the feature store turns it into a per-plan column.
+    from simple_tip_tpu import plan as _plan
+
+    estimate = _plan.phase_estimate(phase, len(pending), workers=num_workers)
+    if estimate is None:
+        estimate = _costmodel.quick_phase_estimate(
+            phase, len(pending), workers=num_workers
+        )
     predicted = {}
+    if _plan.active_plan() is not None:
+        predicted["plan"] = _plan.active_plan_id()
     if estimate is not None:
         predicted["predicted_s"] = estimate["predicted_s"]
         logger.info(
-            "[%s] %s: cost model predicts %.1fs (+/- %.1fs, basis=%s, "
+            "[%s] %s: %s predicts %.1fs (+/- %.1fs, basis=%s, "
             "corpus=%s rows) for %d runs on %d workers",
-            case_study, phase, estimate["predicted_s"],
+            case_study, phase,
+            "plan" if estimate.get("basis") == "plan" else "cost model",
+            estimate["predicted_s"],
             estimate.get("error_s") or 0.0, estimate.get("basis"),
             estimate.get("corpus_rows"), len(pending), num_workers,
         )

@@ -1,0 +1,16 @@
+"""Self-tuning execution planner: knob space, search, ExecutionPlan.
+
+The consumer-side readers are re-exported here so call sites stay one
+cheap import: ``from simple_tip_tpu import plan; plan.phase_estimate(...)``.
+Everything in this package is stdlib-only — it runs in the dependency-free
+tier-0 CI gate.
+"""
+
+from simple_tip_tpu.plan.plan import (  # noqa: F401
+    PLAN_FILE_ENV,
+    UNPLANNED,
+    PlanError,
+    active_plan,
+    active_plan_id,
+    phase_estimate,
+)

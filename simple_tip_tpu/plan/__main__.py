@@ -1,0 +1,8 @@
+"""``python -m simple_tip_tpu.plan`` entry point."""
+
+import sys
+
+from simple_tip_tpu.plan.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

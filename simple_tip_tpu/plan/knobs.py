@@ -1,0 +1,217 @@
+"""The typed knob space the execution planner searches.
+
+Every performance-relevant toggle this repo grew — fit-pool sizing, the
+cluster backend, worker platform policy, the fused-chain switch, batch
+sizes — is an env var today, set by hand per study. This module is the
+registry that makes that space searchable: each :class:`Knob` declares its
+env var, its **legal values** (the planner never invents a value a
+consumer would reject), its default, and **which cost-model features it
+moves** (``obs/costmodel.py`` fits ``[1, cpu?, log1p(count),
+log1p(batch)]`` per phase, divided by workers) — so ``plan/search.py``
+knows which knobs the learned model can actually distinguish and which it
+scores identically (those keep their default, and ``plan explain`` says
+so instead of pretending the model had an opinion).
+
+The registry is also the contract behind the ``hardcoded-knob`` tiplint
+rule: library code must not write these env vars into ``os.environ``
+directly — a hardcoded knob is invisible to the planner, to ``plan
+explain`` and to the plan-vs-actual audit. Scripts and tests stay exempt
+(they are entry points / harnesses, exactly where pinning is legitimate).
+
+Stdlib-only: the planner runs in the dependency-free tier-0 CI gate.
+"""
+
+from typing import Dict, Iterable, Optional, Tuple
+
+#: Cost-model feature names a knob may move (see ``costmodel._features``
+#: plus the ``workers`` divisor in ``predict_study``).
+FEATURES = ("platform", "batch", "workers")
+
+
+class Knob:
+    """One tunable: env var, legal values, and its cost-model effect.
+
+    ``param`` names the prediction parameter the value maps onto
+    identically (``workers`` / ``batch``); ``effects`` maps specific
+    values to prediction-parameter overrides (e.g. ``worker_platforms:
+    cpu -> {"platform": "cpu"}``). A knob with neither moves no feature
+    the model fits: the search keeps its default and the plan records
+    that honestly.
+    """
+
+    __slots__ = ("name", "env", "values", "default", "features", "param",
+                 "effects", "doc")
+
+    def __init__(self, name: str, env: str, values: Tuple, default,
+                 doc: str, features: Tuple[str, ...] = (),
+                 param: Optional[str] = None, effects: Optional[dict] = None):
+        if default not in values:
+            raise ValueError(f"knob {name}: default {default!r} not legal")
+        for f in features:
+            if f not in FEATURES:
+                raise ValueError(f"knob {name}: unknown feature {f!r}")
+        self.name = name
+        self.env = env
+        self.values = tuple(values)
+        self.default = default
+        self.features = tuple(features)
+        self.param = param
+        self.effects = dict(effects or {})
+        self.doc = doc
+
+    def legal(self, value) -> bool:
+        """Whether ``value`` is one of this knob's declared legal values."""
+        return value in self.values
+
+    def prediction_overrides(self, value) -> dict:
+        """Cost-model parameter overrides this knob value implies."""
+        out = dict(self.effects.get(value, {}))
+        if self.param is not None:
+            out[self.param] = value
+        return out
+
+    def coerce(self, raw: str):
+        """Parse a CLI/env string into this knob's typed legal value.
+
+        Raises ``ValueError`` (naming the legal values) on anything else —
+        the planner never silently accepts a value a consumer would
+        reject at launch time.
+        """
+        for v in self.values:
+            if str(v) == str(raw).strip():
+                return v
+        raise ValueError(
+            f"knob {self.name}: {raw!r} is not legal "
+            f"(legal: {', '.join(str(v) for v in self.values)})"
+        )
+
+
+#: The knob space, in the deterministic order the search walks it.
+KNOBS: Tuple[Knob, ...] = (
+    Knob(
+        "batch", "TIP_PLAN_BATCH", (2048, 4096, 8192, 16384, 32768), 8192,
+        doc="scoring/bench batch size quoted to consumers; moves the cost "
+            "model's log1p(batch) feature and the device-memory constraint",
+        features=("batch",), param="batch",
+    ),
+    Knob(
+        "cluster_backend", "TIP_CLUSTER_BACKEND",
+        ("auto", "jax", "sklearn"), "auto",
+        doc="KMeans/GMM backend for the SA fits (ops/surprise.py); "
+            "'sklearn' pins the fits to host CPU",
+        features=("platform",), effects={"sklearn": {"platform": "cpu"}},
+    ),
+    Knob(
+        "fused_chain", "TIP_FUSED_CHAIN", ("0", "1"), "0",
+        doc="whole-chain fused AOT run programs (engine/run_program.py); "
+            "indistinguishable to the current cost-model features, so the "
+            "default is kept unless pinned",
+    ),
+    Knob(
+        "max_badge", "TIP_SERVE_MAX_BADGE", (256, 512, 1024, 2048), 2048,
+        doc="serving badge size bound (serving/knobs.py); the admission "
+            "backlog bound divides by it",
+    ),
+    Knob(
+        "sa_fanout", "TIP_SA_FANOUT", ("auto", "1", "0"), "auto",
+        doc="whole-variant SA fit fan-out (engine/sa_prep.py)",
+    ),
+    Knob(
+        "sa_mem_frac", "TIP_SA_MEM_FRAC", ("0.25", "0.5", "0.75"), "0.5",
+        doc="fraction of available host RAM the SA FitPool fan-out may "
+            "budget (engine/sa_prep.fanout_workers)",
+    ),
+    Knob(
+        "sa_pool", "TIP_SA_POOL", ("auto", "1", "2", "4", "8"), "auto",
+        doc="SA fit-pool process count (engine/sa_prep.pool_size)",
+    ),
+    Knob(
+        "worker_platforms", "TIP_WORKER_PLATFORMS", ("default", "cpu"),
+        "default",
+        doc="scheduler worker platform policy (parallel/run_scheduler.py); "
+            "'cpu' pins every worker off the accelerator",
+        features=("platform",), effects={"cpu": {"platform": "cpu"}},
+    ),
+    Knob(
+        "workers", "TIP_NUM_WORKERS", (1, 2, 4, 8), 1,
+        doc="per-host scheduler worker processes; divides every per-phase "
+            "wall-clock prediction (ideal packing)",
+        features=("workers",), param="workers",
+    ),
+)
+
+_BY_NAME: Dict[str, Knob] = {k.name: k for k in KNOBS}
+
+
+def knob(name: str) -> Knob:
+    """The knob named ``name`` (raises ``KeyError`` with the catalogue)."""
+    try:
+        return _BY_NAME[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown knob {name!r} (knobs: {', '.join(sorted(_BY_NAME))})"
+        ) from None
+
+
+def all_knobs() -> Tuple[Knob, ...]:
+    """Every registered knob, in search order."""
+    return KNOBS
+
+
+def planned_env_vars() -> frozenset:
+    """Env vars owned by the plan/knobs registry.
+
+    The ``hardcoded-knob`` tiplint rule flags library code writing any of
+    these into ``os.environ`` directly: tuning decisions must flow through
+    an ExecutionPlan (or an operator's shell), never a code-level pin.
+    """
+    return frozenset(k.env for k in KNOBS)
+
+
+def default_assignment() -> Dict[str, object]:
+    """The all-defaults knob assignment (the search's starting point)."""
+    return {k.name: k.default for k in KNOBS}
+
+
+def validate_assignment(assignment: dict) -> Dict[str, object]:
+    """Check names and values against the registry; returns a sorted copy.
+
+    Raises ``ValueError`` naming the first offense — a plan carrying an
+    illegal value must fail at load/build time, not at consumer-launch
+    time.
+    """
+    out = {}
+    for name in sorted(assignment):
+        k = knob(name)  # KeyError -> caller surfaces the catalogue
+        value = assignment[name]
+        if not k.legal(value):
+            raise ValueError(
+                f"knob {name}: {value!r} is not legal "
+                f"(legal: {', '.join(str(v) for v in k.values)})"
+            )
+        out[name] = value
+    return out
+
+
+def assignment_env(assignment: dict) -> Dict[str, str]:
+    """The env-var view of ``assignment`` (what ``plan apply`` exports)."""
+    return {
+        knob(name).env: str(value)
+        for name, value in sorted(validate_assignment(assignment).items())
+    }
+
+
+def prediction_params(assignment: dict, platform=None) -> dict:
+    """Fold ``assignment`` into cost-model prediction parameters.
+
+    Starts from the study's target ``platform`` (None = the default
+    backend), workers=1, batch=None, then applies each knob's declared
+    overrides in knob order — the single mapping both the search scorer
+    and ``plan explain`` use, so a plan's stored predictions are exactly
+    what scoring saw.
+    """
+    params = {"platform": platform, "workers": 1, "batch": None}
+    for k in KNOBS:
+        if k.name in assignment:
+            params.update(k.prediction_overrides(assignment[k.name]))
+    return params

@@ -59,9 +59,17 @@ class AdmissionController:
         if not self._cold_estimate_done:
             self._cold_estimate_done = True
             try:
+                # An active ExecutionPlan's serving.badge prediction is the
+                # number the planner sized the rest of the study against —
+                # the backlog bound should agree with it, not with a
+                # fresher fit the plan never saw. Same failure-safe
+                # contract: None on any problem, then the live model.
+                from simple_tip_tpu import plan as _plan
                 from simple_tip_tpu.obs.costmodel import quick_phase_estimate
 
-                est = quick_phase_estimate(self.COST_PHASE, n_runs=1)
+                est = _plan.phase_estimate(self.COST_PHASE, n_runs=1)
+                if est is None:
+                    est = quick_phase_estimate(self.COST_PHASE, n_runs=1)
                 if est and isinstance(est.get("predicted_s"), (int, float)):
                     self._cold_estimate_s = float(est["predicted_s"])
             except Exception:  # noqa: BLE001 — advisory, never load-bearing

@@ -59,6 +59,14 @@ for _var in ["TIP_FUSED_CHAIN", "TIP_INT8_PROFILES"] + [
 ]:
     os.environ.pop(_var, None)
 
+# An inherited TIP_PLAN_FILE would silently activate an ExecutionPlan under
+# every scheduler/serving/bench test (plan-based estimates replacing the
+# cost-model fallbacks the tests pin); the other TIP_PLAN_* knobs would
+# reshape batch sizes and the planner's memory bound. The suite opts into
+# plans per-test via monkeypatch.
+for _var in [v for v in os.environ if v.startswith("TIP_PLAN_")]:
+    os.environ.pop(_var, None)
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

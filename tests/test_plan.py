@@ -1,0 +1,312 @@
+"""Planner tests: determinism, memory rejection, exit-3, schema round-trip.
+
+Everything here runs against the committed feature-store fixture
+``tests/fixtures/plan_corpus/index.jsonl`` (12 rows: test_prio +
+sa_fit.total across batches/platforms, device_peak_bytes on the tpu
+rows), so the suite pins the same contracts the dependency-free CI smoke
+asserts: same corpus + same arguments => byte-identical plan; a
+candidate predicted over memory capacity never wins; a thin corpus exits
+3 loudly; a plan document round-trips and detects tampering.
+"""
+
+import json
+import os
+
+import pytest
+
+from simple_tip_tpu.obs import costmodel, regress, store
+from simple_tip_tpu.plan import cli as plan_cli
+from simple_tip_tpu.plan import knobs, plan as plan_mod, search
+
+FIXTURE_INDEX = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "plan_corpus"
+)
+
+
+def _corpus():
+    rows = store.load_rows(FIXTURE_INDEX)
+    assert rows, "committed plan_corpus fixture must load"
+    return rows
+
+
+def _suggest_argv(extra=()):
+    return [
+        "suggest", "--phases", "test_prio,sa_fit.total", "--runs", "100",
+        "--case-studies", "4", "--platform", "tpu",
+        "--index", FIXTURE_INDEX, *extra,
+    ]
+
+
+# --- knobs registry ---------------------------------------------------------
+
+
+def test_knob_registry_is_typed_and_validating():
+    k = knobs.knob("batch")
+    assert k.env == "TIP_PLAN_BATCH"
+    assert k.coerce("4096") == 4096
+    with pytest.raises(ValueError, match="not legal"):
+        k.coerce("999")
+    with pytest.raises(KeyError, match="unknown knob"):
+        knobs.knob("nope")
+    with pytest.raises(ValueError, match="not legal"):
+        knobs.validate_assignment({"workers": 3})
+    env = knobs.assignment_env(knobs.default_assignment())
+    assert env["TIP_NUM_WORKERS"] == "1"
+    assert set(env) == knobs.planned_env_vars()
+
+
+def test_prediction_params_fold_knob_effects():
+    params = knobs.prediction_params(
+        {"workers": 4, "batch": 2048, "cluster_backend": "sklearn"},
+        platform="tpu",
+    )
+    assert params == {"platform": "cpu", "workers": 4, "batch": 2048}
+
+
+# --- search -----------------------------------------------------------------
+
+
+def test_search_predictions_match_obs_predict():
+    rows = _corpus()
+    result = search.search(rows, ["test_prio", "sa_fit.total"], runs=100,
+                           case_studies=4, platform="tpu")
+    params = knobs.prediction_params(result["assignment"], platform="tpu")
+    direct = costmodel.predict_study(
+        costmodel.fit(rows), ["test_prio", "sa_fit.total"], 100, 4,
+        platform=params["platform"], workers=params["workers"],
+        batch=params["batch"],
+    )
+    assert result["predicted"] == direct
+
+
+def test_search_is_deterministic():
+    rows = _corpus()
+    kwargs = dict(runs=100, case_studies=4, platform="tpu",
+                  capacity_bytes=3_584_000)
+    a = search.search(rows, ["test_prio"], **kwargs)
+    b = search.search(rows, ["test_prio"], **kwargs)
+    assert a == b
+
+
+def test_memory_rejection_never_elects_over_capacity():
+    rows = _corpus()
+    # Unconstrained, the fixture corpus rewards the biggest batch.
+    free = search.search(rows, ["test_prio"], runs=10, platform="tpu")
+    assert free["assignment"]["batch"] == 32768
+    # Fixture peaks: 1_000_000 + 100*batch -> 32768 predicts ~4.3MB.
+    capped = search.search(rows, ["test_prio"], runs=10, platform="tpu",
+                           capacity_bytes=3_584_000)
+    assert capped["assignment"]["batch"] == 16384
+    assert capped["search"]["rejected_memory"] >= 1
+    assert capped["memory"]["constraint"] == "enforced"
+    assert capped["memory"]["predicted_peak_bytes"] <= 3_584_000
+    big = capped["search"]["knobs"]["batch"]["values"]["32768"]
+    assert big["rejected"] == "memory" and big["total_s"] is None
+
+
+def test_every_candidate_over_capacity_is_infeasible():
+    with pytest.raises(search.InfeasiblePlan):
+        search.search(_corpus(), ["test_prio"], runs=10, platform="tpu",
+                      capacity_bytes=1024)
+
+
+def test_capacity_without_peak_rows_is_insufficient_corpus():
+    stripped = [dict(r, device_peak_bytes=None) for r in _corpus()]
+    with pytest.raises(search.InsufficientCorpus, match="device_peak_bytes"):
+        search.search(stripped, ["test_prio"], runs=10, platform="tpu",
+                      capacity_bytes=3_584_000)
+
+
+def test_unknown_phase_is_insufficient_corpus():
+    with pytest.raises(search.InsufficientCorpus):
+        search.search(_corpus(), ["no_such_phase"], runs=10)
+
+
+def test_pinned_knob_is_respected():
+    result = search.search(_corpus(), ["test_prio"], runs=10,
+                           platform="tpu", pinned={"batch": 2048})
+    assert result["assignment"]["batch"] == 2048
+    assert result["search"]["knobs"]["batch"]["pinned"] is True
+
+
+# --- ExecutionPlan artifact -------------------------------------------------
+
+
+def _build_plan(tmp_path, extra=()):
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    out = tmp_path / "plan.json"
+    rc = plan_cli.main(_suggest_argv(("-o", str(out), *extra)))
+    assert rc == 0
+    return out
+
+
+def test_plan_schema_round_trip(tmp_path):
+    path = _build_plan(tmp_path)
+    doc = plan_mod.load(str(path))
+    assert doc["schema"] == plan_mod.SCHEMA
+    assert doc["plan_id"].startswith("ep-")
+    # Canonical bytes: re-serializing the loaded doc reproduces the file.
+    assert plan_mod.to_json(doc) == path.read_text()
+    # Tampering breaks the fingerprint.
+    evil = dict(doc, assignment=dict(doc["assignment"], workers=1))
+    with pytest.raises(plan_mod.PlanError, match="fingerprint"):
+        plan_mod.validate(evil)
+    # An unknown schema stamp is rejected, not misread.
+    with pytest.raises(plan_mod.PlanError, match="schema"):
+        plan_mod.validate(dict(doc, schema=99))
+
+
+def test_cli_suggest_is_byte_identical(tmp_path):
+    a = _build_plan(tmp_path / "a")
+    b = _build_plan(tmp_path / "b")
+    assert a.read_bytes() == b.read_bytes()
+
+
+def test_cli_exit3_on_empty_index(tmp_path, capsys):
+    rc = plan_cli.main([
+        "suggest", "--phases", "test_prio", "--runs", "10",
+        "--index", str(tmp_path / "empty"), "--json",
+    ])
+    assert rc == 3
+    doc = json.loads(capsys.readouterr().out)  # stdout stays valid JSON
+    assert doc["error"] == "insufficient_corpus"
+
+
+def test_cli_exit3_on_unknown_phase(capsys):
+    rc = plan_cli.main([
+        "suggest", "--phases", "no_such_phase", "--runs", "10",
+        "--index", FIXTURE_INDEX,
+    ])
+    assert rc == 3
+    capsys.readouterr()
+
+
+def test_cli_exit2_on_bad_input(capsys):
+    rc = plan_cli.main(_suggest_argv(("--set", "workers=3")))
+    assert rc == 2
+    rc = plan_cli.main(_suggest_argv(("--mem-bytes", "1k")))
+    assert rc == 2  # InfeasiblePlan: every candidate over capacity
+    capsys.readouterr()
+
+
+def test_cli_explain_renders_rejections(tmp_path, capsys):
+    path = _build_plan(tmp_path, extra=("--mem-bytes", "3500k"))
+    capsys.readouterr()
+    assert plan_cli.main(["explain", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "REJECTED: over memory capacity" in out
+    assert "chosen" in out
+
+
+# --- consumer-side readers --------------------------------------------------
+
+
+def test_active_plan_readers_are_failure_safe(tmp_path, monkeypatch):
+    monkeypatch.delenv(plan_mod.PLAN_FILE_ENV, raising=False)
+    assert plan_mod.active_plan() is None
+    assert plan_mod.active_plan_id() == "unplanned"
+    assert plan_mod.phase_estimate("test_prio") is None
+    monkeypatch.setenv(plan_mod.PLAN_FILE_ENV, str(tmp_path / "missing.json"))
+    assert plan_mod.active_plan() is None
+    corrupt = tmp_path / "corrupt.json"
+    corrupt.write_text("{not json")
+    monkeypatch.setenv(plan_mod.PLAN_FILE_ENV, str(corrupt))
+    assert plan_mod.active_plan_id() == "unplanned"
+
+
+def test_phase_estimate_scales_like_predict_study(tmp_path, monkeypatch):
+    path = _build_plan(tmp_path)
+    monkeypatch.setenv(plan_mod.PLAN_FILE_ENV, str(path))
+    doc = plan_mod.load(str(path))
+    per_run = doc["predicted"]["by_phase"]["test_prio"]["per_run_s"]
+    est = plan_mod.phase_estimate("test_prio", 10, workers=2)
+    assert est["basis"] == "plan"
+    assert est["plan_id"] == doc["plan_id"]
+    assert est["predicted_s"] == pytest.approx(per_run * 10 / 2, rel=1e-6)
+    assert plan_mod.phase_estimate("no_such_phase") is None
+
+
+def test_load_corpus_is_cached_by_stat(tmp_path):
+    index_dir = tmp_path / "idx"
+    index_dir.mkdir()
+    rows_path = index_dir / "index.jsonl"
+    src = os.path.join(FIXTURE_INDEX, "index.jsonl")
+    rows_path.write_text(open(src).read())
+    first = store.load_corpus(str(index_dir))
+    assert store.load_corpus(str(index_dir)) is first  # cache hit
+    with open(rows_path, "a") as f:
+        line = dict(first[0], phase="fresh_phase", seq=1)
+        f.write(json.dumps(line, sort_keys=True) + "\n")
+    os.utime(rows_path, (1, 1))  # force a stat change even on coarse clocks
+    second = store.load_corpus(str(index_dir))
+    assert second is not first
+    assert any(r["phase"] == "fresh_phase" for r in second)
+
+
+# --- trend gate: like-for-like plans ---------------------------------------
+
+
+def _bench_snap(value, plan="unplanned", degraded=False):
+    return regress._normalize_bench(
+        {"value": value, "degraded": degraded, "plan": plan}, "<s>"
+    )
+
+
+def test_trend_baseline_filters_to_matching_plan():
+    snaps = [
+        _bench_snap(100.0), _bench_snap(101.0), _bench_snap(99.0),
+        _bench_snap(500.0, plan="ep-aaaaaaaaaaaa"),  # other plan: excluded
+        _bench_snap(100.5),
+    ]
+    result = regress.trend(snaps)
+    assert result["n_baseline"] == 3  # the ep-a record never entered
+    assert result["verdict"] == "ok"
+    # A record measured under a different plan has no comparable baseline.
+    planned = snaps[:4] + [_bench_snap(480.0, plan="ep-aaaaaaaaaaaa")]
+    assert regress.trend(planned)["verdict"] == "no_comparable_baseline"
+
+
+def test_trend_plan_none_keeps_legacy_window():
+    # Snapshot kinds without a plan stamp (host_phase, audit) are untouched.
+    snaps = [
+        {"kind": "host_phase", "source": f"s{i}", "phases": {"p": 1.0},
+         "counters": {}, "degraded": False, "value": None}
+        for i in range(4)
+    ]
+    assert regress.trend(snaps)["n_baseline"] == 3
+
+
+def test_bench_records_normalize_missing_plan_to_unplanned():
+    snap = regress._normalize_bench({"value": 1.0}, "<s>")
+    assert snap["plan"] == "unplanned"
+
+
+# --- feature-store plan column ---------------------------------------------
+
+
+def test_store_parses_plan_column_from_bench_and_spans(tmp_path):
+    bench = tmp_path / "BENCH_r99.json"
+    bench.write_text(json.dumps({
+        "metric": "m", "value": 5.0, "platform": "cpu", "batch": 64,
+        "plan": "ep-feedfeedfeed",
+        "obs_metrics": {"counters": {},
+                        "gauges": {"host.peak_bytes_in_use": 123456}},
+    }))
+    rows = store._rows_from_bench(str(bench), 1)
+    assert rows and all(r["plan"] == "ep-feedfeedfeed" for r in rows)
+    assert rows[0]["device_peak_bytes"] == 123456
+
+    run_dir = tmp_path / "obsrun"
+    run_dir.mkdir()
+    events = [
+        {"type": "meta", "pid": 1, "platform": "cpu", "schema": 1},
+        {"type": "span", "name": "scheduler.phase", "pid": 1, "ts": 1.0,
+         "dur": 2.0, "attrs": {"phase": "test_prio", "runs": 4,
+                               "workers": 2, "plan": "ep-feedfeedfeed"}},
+    ]
+    with open(run_dir / "events-0.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    rows = store._rows_from_obs_run(str(run_dir), 1)
+    sched = [r for r in rows if r["phase"] == "scheduler.test_prio"]
+    assert sched and sched[0]["plan"] == "ep-feedfeedfeed"

@@ -1045,8 +1045,54 @@ class FixtureHandler:
 ''',
 }
 
+BAD_HARDCODED_KNOB = {
+    "engine/tuner.py": '''"""m."""
+import os
+
+os.environ["TIP_NUM_WORKERS"] = "8"
+
+
+def pin_pool():
+    """Hardcodes a planner-owned knob: invisible to any ExecutionPlan."""
+    os.environ.setdefault("TIP_SA_POOL", "4")
+    os.environ.update({"TIP_CLUSTER_BACKEND": "sklearn"})
+''',
+    "parallel/alias.py": '''"""m."""
+from os import environ as env
+
+env["TIP_FUSED_CHAIN"] = "1"
+''',
+}
+
+GOOD_HARDCODED_KNOB = {
+    "engine/reader.py": '''"""m."""
+import os
+
+# Reading a knob is fine; only WRITING one from library code is a pin.
+POOL = os.environ.get("TIP_SA_POOL", "auto")
+
+
+def spawn_env(overrides):
+    """Dynamic keys are plumbing (worker env forwarding), not pins."""
+    os.environ.update(overrides)
+    os.environ["TIP_OBS_WORKER"] = "0"  # not a planner-owned knob
+''',
+    # Scripts and tests are the operator surface: pinning is legitimate.
+    "scripts/mini_env.py": '''"""m."""
+import os
+
+os.environ.setdefault("TIP_WORKER_PLATFORMS", "cpu")
+''',
+    "tests/test_pins.py": '''"""m."""
+import os
+
+os.environ["TIP_NUM_WORKERS"] = "2"
+''',
+}
+
 FIXTURES = {
     "jit-purity": (BAD_JIT_PURITY, GOOD_JIT_PURITY),
+    "hardcoded-knob": (BAD_HARDCODED_KNOB, GOOD_HARDCODED_KNOB),
     "retrace-risk": (BAD_RETRACE_RISK, GOOD_RETRACE_RISK),
     "naked-retry": (BAD_NAKED_RETRY, GOOD_NAKED_RETRY),
     "bare-print": (BAD_BARE_PRINT, GOOD_BARE_PRINT),
