@@ -13,7 +13,13 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 python -m compileall -q simple_tip_tpu scripts tests
+# --baseline: accepted-debt fingerprints (tiplint_baseline.json is empty
+# today — the sweep is clean — but the adoption path stays one flag away).
+# TIPLINT_CACHE (optional): a warm cache replays an unchanged run's
+# findings byte-identically instead of re-running the dataflow fixed
+# points; CI's determinism step exercises exactly that.
 python -m simple_tip_tpu.analysis simple_tip_tpu scripts tests \
+  --baseline tiplint_baseline.json \
   --format "${TIPLINT_FORMAT:-text}"
 # Obs CLI self-check on the committed fixture trace: the run-inspection
 # tooling (simple_tip_tpu/obs — also stdlib-only) must keep parsing the

@@ -1,0 +1,85 @@
+"""tiplint baselines: adopt the analyzer on a codebase with prior debt.
+
+A baseline file records the *accepted* findings of some reference run as
+line-insensitive fingerprints — ``rule|path|message`` with a count — so a
+tree that moves code around (shifting line numbers) keeps its accepted
+debt accepted, while any **new** finding (new rule hit, new message, or
+one more occurrence of an old one) still fails the run.
+
+``--write-baseline`` snapshots the current unsuppressed findings;
+``--baseline`` re-marks covered findings as suppressed before reporting,
+so every reporter (text/json/github/sarif) shows them as carried debt
+rather than failures. The committed ``tiplint_baseline.json`` at the repo
+root is intentionally empty: the sweep is clean today, and the file
+existing keeps the adoption path one flag away when a future rule lands
+with unpayable debt.
+"""
+
+import json
+import os
+from collections import Counter
+from dataclasses import replace
+from typing import Dict, List, Sequence, Tuple
+
+from simple_tip_tpu.analysis.core import Finding
+
+_SCHEMA = 1
+
+
+def fingerprint(finding: Finding) -> str:
+    """The line-insensitive identity of a finding (``rule|path|message``)."""
+    return f"{finding.rule}|{finding.path}|{finding.message}"
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> int:
+    """Snapshot the unsuppressed findings into ``path``; returns the count.
+
+    Published atomically (pid-unique tmp + replace) and serialized with
+    sorted keys so two identical runs write byte-identical baselines.
+    """
+    counts = Counter(
+        fingerprint(f) for f in findings if not f.suppressed
+    )
+    doc = {"schema": _SCHEMA, "fingerprints": dict(sorted(counts.items()))}
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return sum(counts.values())
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """fingerprint -> accepted count; raises ValueError on a bad file."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("schema") != _SCHEMA:
+        raise ValueError(f"{path}: not a tiplint baseline (schema {_SCHEMA})")
+    fps = doc.get("fingerprints")
+    if not isinstance(fps, dict):
+        raise ValueError(f"{path}: baseline has no fingerprint table")
+    return {str(k): int(v) for k, v in fps.items()}
+
+
+def apply_baseline(
+    findings: Sequence[Finding], accepted: Dict[str, int]
+) -> Tuple[List[Finding], int]:
+    """Re-mark baseline-covered findings as suppressed.
+
+    Each fingerprint covers up to its accepted count (first occurrences in
+    the driver's deterministic sort order win — the stable choice). Returns
+    (findings, how many were covered).
+    """
+    budget = dict(accepted)
+    out: List[Finding] = []
+    covered = 0
+    for f in findings:
+        if not f.suppressed:
+            fp = fingerprint(f)
+            if budget.get(fp, 0) > 0:
+                budget[fp] -= 1
+                covered += 1
+                out.append(replace(f, suppressed=True))
+                continue
+        out.append(f)
+    return out, covered

@@ -1,0 +1,142 @@
+"""tiplint run cache: skip re-analysis when nothing it reads has changed.
+
+The dataflow rules (PR 16) made a whole-package sweep meaningfully more
+expensive than the old syntactic pass — interprocedural fixed points over
+the project graph are not free. This cache makes the *second* identical
+run (pre-commit after CI, a re-run in the same worktree, the determinism
+gate in lint.yml) near-instant without any soundness risk: the key is a
+sha256 over
+
+- the stat signature (relpath, size, mtime_ns) of **every analyzed .py
+  file** — edit any input and the key moves;
+- the stat signature of **the analyzer's own source tree**
+  (``simple_tip_tpu/analysis/**``) — edit a rule or the engine and every
+  prior entry is dead, no version constant to forget to bump;
+- the ``select`` restriction, since it changes which rules ran.
+
+Entries are whole-run finding lists, stored as deterministic JSON and
+published atomically (pid-unique tmp + ``os.replace``), so a cache hit
+renders byte-identically to the run that populated it. The store is
+pruned to the most recent :data:`MAX_ENTRIES` by mtime. Stdlib-only,
+like everything under ``analysis/``.
+"""
+
+import hashlib
+import json
+import os
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from simple_tip_tpu.analysis.core import Finding, iter_python_files
+
+#: Cache entries kept after pruning (oldest-mtime entries beyond this go).
+MAX_ENTRIES = 32
+
+_SCHEMA = 1
+
+
+def _stat_sig(path: str, rel: str) -> Optional[Tuple[str, int, int]]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (rel, st.st_size, st.st_mtime_ns)
+
+
+def _analyzer_files() -> Iterable[Tuple[str, str]]:
+    root = os.path.dirname(os.path.abspath(__file__))
+    for path, _ in iter_python_files([root]):
+        yield path, os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def run_key(
+    paths: Sequence[str], select: Optional[Sequence[str]]
+) -> str:
+    """The cache key for analyzing ``paths`` under ``select`` right now."""
+    sigs: List[Tuple[str, Tuple]] = []
+    for path, root in iter_python_files(paths):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        sig = _stat_sig(path, f"{os.path.basename(root)}/{rel}")
+        if sig is not None:
+            sigs.append(("in", sig))
+    for path, rel in _analyzer_files():
+        sig = _stat_sig(path, rel)
+        if sig is not None:
+            sigs.append(("self", sig))
+    payload = json.dumps(
+        {
+            "schema": _SCHEMA,
+            "select": sorted(select) if select else None,
+            "files": sorted(sigs),
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()
+
+
+def _entry_path(cache_dir: str, key: str) -> str:
+    return os.path.join(cache_dir, f"tiplint_{key}.json")
+
+
+def load(cache_dir: str, key: str) -> Optional[List[Finding]]:
+    """The cached finding list for ``key``, or None (miss/corrupt)."""
+    try:
+        with open(_entry_path(cache_dir, key), encoding="utf-8") as f:
+            doc = json.load(f)
+        if doc.get("schema") != _SCHEMA:
+            return None
+        return [
+            Finding(
+                rule=r["rule"],
+                path=r["path"],
+                line=int(r["line"]),
+                message=r["message"],
+                suppressed=bool(r["suppressed"]),
+            )
+            for r in doc["findings"]
+        ]
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def store(cache_dir: str, key: str, findings: Sequence[Finding]) -> None:
+    """Publish ``findings`` under ``key`` atomically; best-effort only."""
+    doc = {
+        "schema": _SCHEMA,
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "message": f.message,
+                "suppressed": f.suppressed,
+            }
+            for f in findings
+        ],
+    }
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        final = _entry_path(cache_dir, key)
+        tmp = f"{final}.{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, sort_keys=True)
+        os.replace(tmp, final)
+        _prune(cache_dir)
+    except OSError:
+        pass  # a cache that can't write is a slow run, not a failure
+
+
+def _prune(cache_dir: str) -> None:
+    entries = []
+    for name in os.listdir(cache_dir):
+        if name.startswith("tiplint_") and name.endswith(".json"):
+            full = os.path.join(cache_dir, name)
+            try:
+                entries.append((os.stat(full).st_mtime_ns, full))
+            except OSError:
+                continue
+    entries.sort(reverse=True)
+    for _, full in entries[MAX_ENTRIES:]:
+        try:
+            os.remove(full)
+        except OSError:
+            continue

@@ -3,12 +3,26 @@
 Exit status is the contract consumed by scripts/lint.sh and CI: 0 when every
 finding is suppressed (or there are none), 1 when unsuppressed findings
 remain, 2 on usage errors.
+
+Beyond the core sweep the CLI owns three workflow modes:
+
+- ``--baseline FILE`` / ``--write-baseline FILE`` — adopt-with-debt: accept
+  a recorded set of findings (line-insensitive fingerprints) as suppressed
+  (``analysis/baseline.py``);
+- ``--changed-only [REF]`` — scope reporting to files git considers changed
+  against REF (default HEAD) plus untracked files; the whole tree is still
+  parsed so project-graph and dataflow rules see the full program;
+- ``--cache DIR`` (or ``$TIPLINT_CACHE``) — reuse a prior identical run's
+  findings when no analyzed file and no analyzer source changed
+  (``analysis/cache.py``); announced on stderr, bypassed under
+  ``--changed-only`` (scoped runs are cheap and git state isn't keyed).
 """
 
 import argparse
 import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from simple_tip_tpu.analysis.core import all_rules, analyze_paths, unsuppressed
 from simple_tip_tpu.analysis.reporters import REPORTERS, render
@@ -26,9 +40,11 @@ def build_parser() -> argparse.ArgumentParser:
         description=(
             "tiplint: JAX/TPU-aware static analysis for simple_tip_tpu "
             "(jit purity, PRNG hygiene, host syncs, f64-on-TPU, buffer "
-            "donation, artifact contract, docstring coverage, and the "
+            "donation, artifact contract, docstring coverage, the "
             "project-graph rules: sharding-spec-mismatch, "
-            "shape-polymorphism, transitive-jit-purity)."
+            "shape-polymorphism, transitive-jit-purity, and the dataflow "
+            "rules: use-after-donate, escaping-tracer, unsafe-bus-write, "
+            "knob-contract)."
         ),
     )
     parser.add_argument(
@@ -52,7 +68,82 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="print the registered rules and exit",
     )
+    parser.add_argument(
+        "--baseline",
+        metavar="FILE",
+        default=None,
+        help=(
+            "accept findings recorded in this baseline file as suppressed "
+            "(line-insensitive rule|path|message fingerprints)"
+        ),
+    )
+    parser.add_argument(
+        "--write-baseline",
+        metavar="FILE",
+        default=None,
+        help=(
+            "write the current unsuppressed findings as a baseline file "
+            "and exit 0 (the adopt-with-debt snapshot)"
+        ),
+    )
+    parser.add_argument(
+        "--changed-only",
+        nargs="?",
+        const="HEAD",
+        default=None,
+        metavar="REF",
+        help=(
+            "report only findings in files changed vs REF (default HEAD) "
+            "per git, plus untracked files; the full tree is still parsed "
+            "so cross-file rules keep whole-program context"
+        ),
+    )
+    parser.add_argument(
+        "--cache",
+        metavar="DIR",
+        default=os.environ.get("TIPLINT_CACHE") or None,
+        help=(
+            "findings cache directory (default: $TIPLINT_CACHE); a re-run "
+            "with unchanged inputs and unchanged analyzer source replays "
+            "the stored findings byte-identically"
+        ),
+    )
     return parser
+
+
+def _changed_files(paths: List[str], ref: str) -> Optional[Set[str]]:
+    """Absolute paths of .py files changed vs ``ref`` (plus untracked),
+    or None when git can't answer (not a repo / bad ref)."""
+    anchor = paths[0]
+    cwd = anchor if os.path.isdir(anchor) else os.path.dirname(anchor)
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=cwd, capture_output=True, text=True, timeout=30,
+        )
+        if top.returncode != 0:
+            return None
+        root = top.stdout.strip()
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        if untracked.returncode != 0:
+            return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    out: Set[str] = set()
+    for line in diff.stdout.splitlines() + untracked.stdout.splitlines():
+        line = line.strip()
+        if line.endswith(".py"):
+            out.add(os.path.abspath(os.path.join(root, line)))
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -72,11 +163,70 @@ def main(argv: Optional[List[str]] = None) -> int:
     select = None
     if args.select:
         select = [s.strip() for s in args.select.split(",") if s.strip()]
-    try:
-        findings = analyze_paths(paths, select=select)
-    except KeyError as exc:
-        print(f"tiplint: {exc.args[0]}", file=sys.stderr)
-        return 2
+
+    only_paths: Optional[Set[str]] = None
+    if args.changed_only is not None:
+        only_paths = _changed_files(paths, args.changed_only)
+        if only_paths is None:
+            print(
+                f"tiplint: --changed-only: git could not diff against "
+                f"{args.changed_only!r} (not a repository, or unknown ref)",
+                file=sys.stderr,
+            )
+            return 2
+
+    use_cache = args.cache if only_paths is None else None
+    cache_key = None
+    findings = None
+    if use_cache:
+        from simple_tip_tpu.analysis import cache as _cache
+
+        cache_key = _cache.run_key(paths, select)
+        findings = _cache.load(use_cache, cache_key)
+        if findings is not None:
+            print(f"tiplint: cache hit ({cache_key[:12]})", file=sys.stderr)
+
+    if findings is None:
+        try:
+            findings = analyze_paths(paths, select=select, only_paths=only_paths)
+        except KeyError as exc:
+            print(f"tiplint: {exc.args[0]}", file=sys.stderr)
+            return 2
+        if use_cache and cache_key is not None:
+            from simple_tip_tpu.analysis import cache as _cache
+
+            _cache.store(use_cache, cache_key, findings)
+
+    if args.write_baseline:
+        from simple_tip_tpu.analysis.baseline import write_baseline
+
+        count = write_baseline(args.write_baseline, findings)
+        print(
+            f"tiplint: wrote baseline {args.write_baseline} "
+            f"({count} accepted finding(s))",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.baseline:
+        from simple_tip_tpu.analysis.baseline import (
+            apply_baseline,
+            load_baseline,
+        )
+
+        try:
+            accepted = load_baseline(args.baseline)
+        except (OSError, ValueError) as exc:
+            print(f"tiplint: --baseline: {exc}", file=sys.stderr)
+            return 2
+        findings, covered = apply_baseline(findings, accepted)
+        if covered:
+            print(
+                f"tiplint: {covered} finding(s) covered by baseline "
+                f"{args.baseline}",
+                file=sys.stderr,
+            )
+
     try:
         print(render(findings, args.format))
         sys.stdout.flush()

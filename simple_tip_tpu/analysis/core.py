@@ -193,18 +193,28 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[Tuple[str, str]]:
 
 
 def analyze_paths(
-    paths: Sequence[str], select: Optional[Sequence[str]] = None
+    paths: Sequence[str],
+    select: Optional[Sequence[str]] = None,
+    only_paths: Optional[Set[str]] = None,
 ) -> List[Finding]:
     """Run the (selected) rules over every module under ``paths``.
 
     Returns all findings, suppressed ones included (marked); callers decide
     what fails the run (the CLI exits non-zero on any unsuppressed finding).
 
-    When no ``select`` restriction is given, suppression comments that
-    disabled nothing during the run are themselves reported as synthetic
-    ``unused-suppression`` findings (like ``parse-error``, not a registered
-    rule), so stale suppressions can't rot silently after the code they
-    justified is refactored away.
+    ``only_paths`` (absolute file paths) restricts *reporting* to those
+    modules — the whole tree is still parsed so package rules see the full
+    program, but per-module rules skip out-of-scope files and package-rule
+    findings attributed elsewhere are dropped. This is the engine behind
+    ``--changed-only``.
+
+    When no ``select`` restriction is given AND the sweep was whole-project
+    (no ``only_paths``), suppression comments that disabled nothing during
+    the run are themselves reported as synthetic ``unused-suppression``
+    findings (like ``parse-error``, not a registered rule), so stale
+    suppressions can't rot silently after the code they justified is
+    refactored away. A scoped run must NOT audit: a suppression whose rule
+    fires only from out-of-scope files would be falsely reported stale.
     """
     rules = all_rules()
     if select:
@@ -226,6 +236,8 @@ def analyze_paths(
         try:
             info = ModuleInfo.parse(path, root)
         except SyntaxError as exc:
+            if only_paths is not None and path not in only_paths:
+                continue
             findings.append(
                 Finding(
                     rule="parse-error",
@@ -268,8 +280,17 @@ def analyze_paths(
                     suppressed=suppressed)
         )
 
+    def in_scope(module: Optional[ModuleInfo], path_hint: Optional[str]) -> bool:
+        if only_paths is None:
+            return True
+        if module is not None:
+            return module.path in only_paths
+        return path_hint in only_paths
+
     for rule in rules.values():
         for module in modules:
+            if not in_scope(module, None):
+                continue
             for _rel, line, msg in rule.check_module(module):
                 emit(rule.name, module, None, line, msg)
         for key, line, msg in rule.check_package(modules):
@@ -278,9 +299,11 @@ def analyze_paths(
             module = by_key.get(key)
             if module is None:
                 module = by_rel.get(key)
+            if not in_scope(module, key):
+                continue
             emit(rule.name, module, key, line, msg)
 
-    if select is None:
+    if select is None and only_paths is None:
         _report_unused_suppressions(modules, rules, used, emit)
 
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))

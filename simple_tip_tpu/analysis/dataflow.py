@@ -1,0 +1,960 @@
+"""tiplint dataflow: def-use/reaching-definitions over per-function CFGs.
+
+The per-file rules are syntactic (one statement at a time) and the project
+graph (``analysis/graph.py``) is topological (who calls whom, who traces
+whom). Neither can answer the questions the repo's runtime contracts
+actually pose — *is this buffer read again after the jit donated it*, *does
+this path string derive from a shared-bus root before it reaches a raw
+write*, *which literal env name ends up inside that helper's
+``os.environ.get``*. Those are dataflow questions, and this module is the
+engine the flow-sensitive rules (``use-after-donate``, ``escaping-tracer``,
+``unsafe-bus-write``, ``knob-contract``) are built on:
+
+- **CFG**: a statement-level control-flow graph per function body, with
+  branch joins (``if``/``try``/``match``), loop back edges (``for``/
+  ``while``), and ``break``/``continue``/``return`` handled — so "after"
+  means *along some execution path*, including the second loop iteration;
+- **def/use**: per CFG node, the local names read and written, with
+  aug-assign counting as both, attribute/subscript stores counting as reads
+  of their base, and nested functions contributing their free-variable
+  reads (a closure capture is a use) but never their local writes;
+- **poison propagation** (:meth:`FunctionFlow.reaching_uses`): seed a name
+  at a statement, kill it at redefinitions, report every read some path can
+  still reach — the use-after-donate core;
+- **taint propagation** (:func:`taint_names`): name-level fixed point over
+  a function body with rule-supplied seeds, provenance *chains* (def site →
+  each assignment hop → the violating use, rendered into findings), and a
+  pid-uniqueness bit so the atomic tmp-file idiom is recognized;
+- **interprocedural stitching** (:class:`ProjectFlow`): summaries over the
+  project graph's call edges — "this helper's return value is bus-derived"
+  and "this helper reads the env var its parameter names" — iterated to a
+  fixed point, so ``_env("TIP_SERVE_INFLIGHT", ...)`` is a knob read at the
+  call site and ``default_index_dir()`` taints every path built from it.
+
+Everything is stdlib-``ast`` and best-effort: unresolved means unknown,
+never unsafe. Like the graph, a :class:`ProjectFlow` is built once per run
+(:func:`project_flow`, identity-cached on the module list).
+"""
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import (
+    Callable, Dict, Iterator, List, Optional, Sequence, Set, Tuple,
+)
+
+from simple_tip_tpu.analysis.core import ModuleInfo
+from simple_tip_tpu.analysis.graph import FunctionInfo, ProjectGraph, project_graph
+from simple_tip_tpu.analysis.rules.common import (
+    FunctionNode,
+    callee_name,
+    import_aliases,
+    lambda_or_def_params,
+    parent_map,
+)
+
+_FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def scope_walk(node: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` minus nested function subtrees — the traversal for
+    facts about *one* scope (inner defs keep their own environments)."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if not isinstance(child, _FUNCTION_NODES):
+            yield from scope_walk(child)
+
+
+def nested_defs(fn: FunctionNode) -> Dict[str, ast.AST]:
+    """name -> def node for functions defined directly in ``fn``'s scope
+    (closure helpers like ``def _num(var, default)`` inside ``from_env``
+    — these are not project-graph functions, so call resolution to them
+    is by local name)."""
+    out: Dict[str, ast.AST] = {}
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[child.name] = child
+            elif not isinstance(child, ast.Lambda):
+                visit(child)
+
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[stmt.name] = stmt
+        else:
+            visit(stmt)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-statement def/use extraction
+# ---------------------------------------------------------------------------
+
+
+def _free_reads(fn: FunctionNode) -> Set[str]:
+    """Free-variable reads of a nested function (loads minus its own
+    params and local writes) — a closure capture is a use at the def site."""
+    reads: Set[str] = set()
+    writes: Set[str] = set(lambda_or_def_params(fn))
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    reads.add(node.id)
+                else:
+                    writes.add(node.id)
+    return reads - writes
+
+
+def _collect(node: ast.AST, reads: Set[str], writes: Set[str]) -> None:
+    """Accumulate name reads/writes of one expression/statement subtree.
+
+    Nested function bodies contribute free reads only — their local
+    writes must never kill a poison in the enclosing frame."""
+    if isinstance(node, _FUNCTION_NODES):
+        reads |= _free_reads(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            writes.add(node.name)
+            for d in node.decorator_list:
+                _collect(d, reads, writes)
+            for default in node.args.defaults + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                _collect(default, reads, writes)
+        return
+    if isinstance(node, ast.ClassDef):
+        writes.add(node.name)
+        for d in node.decorator_list + node.bases:
+            _collect(d, reads, writes)
+        for stmt in node.body:  # class bodies execute: reads are real
+            sub_w: Set[str] = set()
+            _collect(stmt, reads, sub_w)  # class-namespace writes dropped
+        return
+    if isinstance(node, ast.Name):
+        if isinstance(node.ctx, ast.Load):
+            reads.add(node.id)
+        else:
+            writes.add(node.id)
+        return
+    if isinstance(node, ast.AugAssign):
+        # x += ... reads AND writes x
+        for sub in ast.walk(node.target):
+            if isinstance(sub, ast.Name):
+                reads.add(sub.id)
+                writes.add(sub.id)
+            elif isinstance(sub, (ast.Attribute, ast.Subscript)):
+                _collect(sub.value, reads, writes)
+        _collect(node.value, reads, writes)
+        return
+    if isinstance(node, (ast.Import, ast.ImportFrom)):
+        for a in node.names:
+            writes.add((a.asname or a.name).split(".")[0])
+        return
+    for child in ast.iter_child_nodes(node):
+        _collect(child, reads, writes)
+
+
+def _own_parts(stmt: ast.stmt) -> List[ast.AST]:
+    """The AST fragments a compound statement's *own* CFG node evaluates
+    (its header), or the whole statement for simple statements."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter, stmt.target]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        parts: List[ast.AST] = []
+        for item in stmt.items:
+            parts.append(item.context_expr)
+            if item.optional_vars is not None:
+                parts.append(item.optional_vars)
+        return parts
+    if isinstance(stmt, ast.Try):
+        return []
+    return [stmt]
+
+
+# ---------------------------------------------------------------------------
+# statement-level CFG
+# ---------------------------------------------------------------------------
+
+
+class CFG:
+    """Control-flow graph over one function body, one node per statement.
+
+    ``succ[i]`` is the set of statement indices execution may continue to
+    after statement ``i``; loop bodies edge back to their header, so a
+    path "around the loop" exists for reaching-uses queries."""
+
+    def __init__(self, fn: FunctionNode):
+        self.stmts: List[ast.stmt] = []
+        self.succ: Dict[int, Set[int]] = {}
+        body = fn.body if isinstance(fn.body, list) else []
+        self.entry: Set[int] = set()
+        exits = self._block(body, preds=set(), loops=[], entry=True)
+        self.exits: Set[int] = exits
+
+    def _add(self, stmt: ast.stmt) -> int:
+        i = len(self.stmts)
+        self.stmts.append(stmt)
+        self.succ[i] = set()
+        return i
+
+    def _block(
+        self,
+        stmts: Sequence[ast.stmt],
+        preds: Set[int],
+        loops: List[Tuple[int, List[int]]],
+        entry: bool = False,
+    ) -> Set[int]:
+        for stmt in stmts:
+            i = self._add(stmt)
+            if entry:
+                self.entry.add(i)
+                entry = False
+            for p in preds:
+                self.succ[p].add(i)
+            preds = self._stmt(stmt, i, loops)
+            if not preds:
+                break  # everything after return/raise/break is unreachable
+        return preds
+
+    def _stmt(
+        self, stmt: ast.stmt, i: int, loops: List[Tuple[int, List[int]]]
+    ) -> Set[int]:
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            return set()
+        if isinstance(stmt, ast.Break):
+            if loops:
+                loops[-1][1].append(i)
+            return set()
+        if isinstance(stmt, ast.Continue):
+            if loops:
+                self.succ[i].add(loops[-1][0])
+            return set()
+        if isinstance(stmt, ast.If):
+            then_exits = self._block(stmt.body, {i}, loops)
+            else_exits = self._block(stmt.orelse, {i}, loops) if stmt.orelse else {i}
+            return then_exits | else_exits
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            breaks: List[int] = []
+            loops.append((i, breaks))
+            body_exits = self._block(stmt.body, {i}, loops)
+            loops.pop()
+            for p in body_exits:
+                self.succ[p].add(i)  # loop back edge
+            exits = {i}
+            if stmt.orelse:
+                exits = self._block(stmt.orelse, exits, loops)
+            return exits | set(breaks)
+        if isinstance(stmt, ast.Try):
+            first_body = len(self.stmts)
+            body_exits = self._block(stmt.body, {i}, loops)
+            body_nodes = set(range(first_body, len(self.stmts)))
+            handler_exits: Set[int] = set()
+            for handler in stmt.handlers:
+                # any body statement may raise into the handler
+                handler_exits |= self._block(
+                    handler.body, {i} | body_nodes, loops
+                )
+            else_exits = (
+                self._block(stmt.orelse, body_exits, loops)
+                if stmt.orelse
+                else body_exits
+            )
+            merged = else_exits | handler_exits
+            if stmt.finalbody:
+                merged = self._block(stmt.finalbody, merged, loops)
+            return merged
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._block(stmt.body, {i}, loops)
+        if isinstance(stmt, ast.Match):
+            exits: Set[int] = {i}  # no case may match: fall through
+            for case in stmt.cases:
+                exits |= self._block(case.body, {i}, loops)
+            return exits
+        return {i}
+
+
+# ---------------------------------------------------------------------------
+# FunctionFlow: CFG + def/use + poison propagation
+# ---------------------------------------------------------------------------
+
+
+class FunctionFlow:
+    """Def-use view of one function body, queryable by rules.
+
+    ``reads(i)``/``writes(i)`` are the names statement ``i``'s own CFG node
+    loads and stores; :meth:`reaching_uses` is the poison query the
+    use-after-donate rule runs after every donating dispatch."""
+
+    def __init__(self, fn: FunctionNode):
+        self.fn = fn
+        self.cfg = CFG(fn)
+        self._reads: List[Set[str]] = []
+        self._writes: List[Set[str]] = []
+        self._stmt_of: Dict[int, int] = {}  # id(descendant) -> stmt index
+        for i, stmt in enumerate(self.cfg.stmts):
+            reads: Set[str] = set()
+            writes: Set[str] = set()
+            for part in _own_parts(stmt):
+                _collect(part, reads, writes)
+                for node in ast.walk(part):
+                    self._stmt_of.setdefault(id(node), i)
+            self._reads.append(reads)
+            self._writes.append(writes)
+            self._stmt_of.setdefault(id(stmt), i)
+
+    def reads(self, i: int) -> Set[str]:
+        """Names statement ``i`` loads."""
+        return self._reads[i]
+
+    def writes(self, i: int) -> Set[str]:
+        """Names statement ``i`` stores (a poison kill)."""
+        return self._writes[i]
+
+    def statement_of(self, node: ast.AST) -> Optional[int]:
+        """The CFG statement index whose own node contains ``node``."""
+        return self._stmt_of.get(id(node))
+
+    def reaching_uses(self, start: int, name: str) -> List[ast.stmt]:
+        """Statements reading ``name`` on some CFG path after ``start``
+        before any redefinition — line-sorted, each statement once.
+
+        The start statement itself is excluded, but remains reachable
+        through a loop back edge: an un-rebound name consumed again on the
+        next iteration is exactly the donate bug this exists to find.
+        Callers must first check ``name in writes(start)`` — a statement
+        that rebinds the name (``params, opt = step(params, opt)``) kills
+        its own poison before any successor runs."""
+        hits: Dict[int, ast.stmt] = {}
+        seen: Set[int] = set()
+        work = list(self.cfg.succ.get(start, ()))
+        while work:
+            i = work.pop()
+            if i in seen:
+                continue
+            seen.add(i)
+            if name in self._reads[i]:
+                hits[i] = self.cfg.stmts[i]
+            if name in self._writes[i]:
+                continue  # redefined: poison dead past this statement
+            work.extend(self.cfg.succ.get(i, ()))
+        return sorted(hits.values(), key=lambda s: (s.lineno, s.col_offset))
+
+
+# ---------------------------------------------------------------------------
+# taint propagation with provenance chains
+# ---------------------------------------------------------------------------
+
+#: Calls whose presence in an expression marks the value process-unique —
+#: the atomic tmp-file idiom's discriminator.
+_PID_UNIQUE_CALLEES = {
+    "os.getpid", "getpid", "uuid.uuid4", "uuid4",
+    "tempfile.mkstemp", "mkstemp",
+    "tempfile.NamedTemporaryFile", "NamedTemporaryFile",
+}
+
+
+@dataclass(frozen=True)
+class Taint:
+    """Why a value is tainted: a provenance chain of (line, description)
+    hops from the seed to the expression at hand, plus whether the value
+    is process-unique (contains a getpid/mkstemp/uuid component)."""
+
+    chain: Tuple[Tuple[int, str], ...]
+    pid_unique: bool = False
+
+    def extend(self, line: int, desc: str) -> "Taint":
+        """A new hop appended (chains are capped so messages stay short)."""
+        chain = self.chain if len(self.chain) >= 6 else self.chain + ((line, desc),)
+        return Taint(chain=chain, pid_unique=self.pid_unique)
+
+    def render(self) -> str:
+        """``def site -> hop -> hop`` text for finding messages."""
+        return " -> ".join(f"{desc} (line {line})" for line, desc in self.chain)
+
+
+#: A seed callback: non-None description when the expression node itself
+#: originates taint (e.g. "reads $TIP_OBS_INDEX", "literal 'journal' path").
+SeedFn = Callable[[ast.AST], Optional[str]]
+
+#: A call-effect callback: Taint for a call's return value, given the call
+#: node and a resolver for argument taint (interprocedural summaries).
+CallFn = Callable[[ast.Call, Callable[[ast.AST], Optional[Taint]]], Optional[Taint]]
+
+
+def _pid_unique_expr(expr: ast.AST, aliases: Dict[str, str]) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = callee_name(node, aliases)
+            if name in _PID_UNIQUE_CALLEES:
+                return True
+    return False
+
+
+class TaintEnv:
+    """Name -> Taint environment for one function (or module) body.
+
+    Flow-insensitive fixed point: a name is tainted when any assignment
+    reachable in the body binds it to a tainted expression. Taint flows
+    through f-strings, concatenation, ``os.path.join`` (any call's
+    arguments taint its result — path helpers are pass-through), tuple
+    unpacking, and the optional ``call_effect`` interprocedural summary."""
+
+    def __init__(
+        self,
+        fn_body: Sequence[ast.stmt],
+        aliases: Dict[str, str],
+        seed: SeedFn,
+        call_effect: Optional[CallFn] = None,
+        param_taints: Optional[Dict[str, Taint]] = None,
+    ):
+        self._aliases = aliases
+        self._seed = seed
+        self._call_effect = call_effect
+        self.names: Dict[str, Taint] = dict(param_taints or {})
+        assigns = self._assignments(fn_body)
+        for _ in range(8):  # fixed point; chains are capped so this converges
+            changed = False
+            for targets, value in assigns:
+                taint = self.expr_taint(value)
+                if taint is None:
+                    continue
+                for target in targets:
+                    changed |= self._bind(target, value, taint)
+            if not changed:
+                break
+
+    def _assignments(
+        self, body: Sequence[ast.stmt]
+    ) -> List[Tuple[List[ast.expr], ast.expr]]:
+        out: List[Tuple[List[ast.expr], ast.expr]] = []
+        for stmt in body:
+            for node in scope_walk(stmt):
+                if isinstance(node, ast.Assign):
+                    out.append((list(node.targets), node.value))
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    out.append(([node.target], node.value))
+                elif isinstance(node, ast.AugAssign):
+                    out.append(([node.target], node.value))
+                elif isinstance(node, ast.NamedExpr):
+                    out.append(([node.target], node.value))
+                elif isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        if item.optional_vars is not None:
+                            out.append(
+                                ([item.optional_vars], item.context_expr)
+                            )
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    out.append(([node.target], node.iter))
+        return out
+
+    def _bind(self, target: ast.expr, value: ast.expr, taint: Taint) -> bool:
+        changed = False
+        if isinstance(target, ast.Tuple) and isinstance(value, ast.Tuple) and (
+            len(target.elts) == len(value.elts)
+        ):
+            for t, v in zip(target.elts, value.elts):
+                sub = self.expr_taint(v)
+                if sub is not None:
+                    changed |= self._bind(t, v, sub)
+            return changed
+        names: List[Tuple[str, int]] = []
+        if isinstance(target, ast.Name):
+            names.append((target.id, target.lineno))
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                if isinstance(el, ast.Name):
+                    names.append((el.id, el.lineno))
+        elif isinstance(target, ast.Attribute):
+            names.append((f"<attr>{target.attr}", target.lineno))
+        for name, line in names:
+            if name not in self.names:
+                self.names[name] = taint.extend(line, f"`{name}` =")
+                changed = True
+        return changed
+
+    def expr_taint(self, expr: ast.AST) -> Optional[Taint]:
+        """The Taint of an expression under the current environment."""
+        taint = self._expr_taint(expr)
+        if taint is not None and not taint.pid_unique:
+            if _pid_unique_expr(expr, self._aliases):
+                taint = Taint(chain=taint.chain, pid_unique=True)
+        return taint
+
+    def _expr_taint(self, expr: ast.AST) -> Optional[Taint]:
+        if isinstance(expr, _FUNCTION_NODES):
+            return None
+        desc = self._seed(expr)
+        if desc is not None:
+            return Taint(chain=((getattr(expr, "lineno", 0), desc),))
+        if isinstance(expr, ast.Name) and expr.id in self.names:
+            return self.names[expr.id]
+        if isinstance(expr, ast.Attribute):
+            key = f"<attr>{expr.attr}"
+            if key in self.names:
+                return self.names[key]
+        if isinstance(expr, ast.Call) and self._call_effect is not None:
+            taint = self._call_effect(expr, self.expr_taint)
+            if taint is not None:
+                return taint.extend(
+                    expr.lineno, f"{callee_name(expr, self._aliases) or 'call'}()"
+                )
+        for child in ast.iter_child_nodes(expr):
+            taint = self._expr_taint(child)
+            if taint is not None:
+                return taint
+        return None
+
+
+# ---------------------------------------------------------------------------
+# env-read detection (shared by knob-contract and the bus seeds)
+# ---------------------------------------------------------------------------
+
+
+def environ_alias_names(tree: ast.Module) -> Set[str]:
+    """Local names bound to ``os.environ`` via ``from os import environ``."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "os":
+            for alias in node.names:
+                if alias.name == "environ":
+                    names.add(alias.asname or "environ")
+    return names
+
+
+def _is_environ(node: ast.AST, environ_names: Set[str]) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return isinstance(node.value, ast.Name) and node.value.id == "os"
+    return isinstance(node, ast.Name) and node.id in environ_names
+
+
+def env_read_key(
+    node: ast.AST, aliases: Dict[str, str], environ_names: Set[str]
+) -> Optional[ast.expr]:
+    """The key expression when ``node`` reads ``os.environ`` — covers
+    ``os.environ.get(K)``, ``os.environ.setdefault(K, d)`` (a read too),
+    ``os.getenv(K)`` and ``os.environ[K]`` loads — else None."""
+    if isinstance(node, ast.Call):
+        name = callee_name(node, aliases)
+        if name in ("os.getenv", "getenv") and node.args:
+            return node.args[0]
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("get", "setdefault")
+            and _is_environ(node.func.value, environ_names)
+            and node.args
+        ):
+            return node.args[0]
+    if (
+        isinstance(node, ast.Subscript)
+        and isinstance(node.ctx, ast.Load)
+        and _is_environ(node.value, environ_names)
+    ):
+        return node.slice
+    return None
+
+
+@dataclass(frozen=True)
+class EnvRead:
+    """One literal env-var read, possibly through a helper call chain."""
+
+    module: ModuleInfo
+    line: int
+    env: str
+    via: str = ""  # "" for a direct read; helper dotted name otherwise
+
+
+# ---------------------------------------------------------------------------
+# ProjectFlow: interprocedural stitching over the project graph
+# ---------------------------------------------------------------------------
+
+
+@dataclass(eq=False)
+class _FnSummary:
+    """Interprocedural facts about one project function."""
+
+    env_params: Set[str] = field(default_factory=set)  # params read as env keys
+    returns_seeded: bool = False  # return value tainted by in-body seeds
+
+
+class ProjectFlow:
+    """Dataflow layer over one run's :class:`ProjectGraph`.
+
+    Summaries are computed to a fixed point over the graph's call edges
+    (including the ``partial``-binding and ``self.``-method edges), so a
+    helper two hops from the env read or the bus seed still carries the
+    fact to its call sites."""
+
+    def __init__(self, modules: Sequence[ModuleInfo]):
+        self.modules = modules
+        self.graph: ProjectGraph = project_graph(modules)
+        self._flows: Dict[int, FunctionFlow] = {}
+        self._aliases: Dict[int, Dict[str, str]] = {}
+        self._environ_names: Dict[int, Set[str]] = {}
+        self._parents: Dict[int, Dict[ast.AST, ast.AST]] = {}
+        self._env_reads: Optional[List[EnvRead]] = None
+
+    # -- per-module memos --------------------------------------------------
+
+    def flow(self, fn: FunctionNode) -> FunctionFlow:
+        """The (cached) FunctionFlow of a function node."""
+        key = id(fn)
+        if key not in self._flows:
+            self._flows[key] = FunctionFlow(fn)
+        return self._flows[key]
+
+    def aliases(self, module: ModuleInfo) -> Dict[str, str]:
+        """The module's import aliases (cached)."""
+        key = id(module)
+        if key not in self._aliases:
+            self._aliases[key] = import_aliases(module.tree)
+        return self._aliases[key]
+
+    def environ_names(self, module: ModuleInfo) -> Set[str]:
+        """Local ``os.environ`` aliases of a module (cached)."""
+        key = id(module)
+        if key not in self._environ_names:
+            self._environ_names[key] = environ_alias_names(module.tree)
+        return self._environ_names[key]
+
+    def parents(self, module: ModuleInfo) -> Dict[ast.AST, ast.AST]:
+        """child -> parent map of a module tree (cached)."""
+        key = id(module)
+        if key not in self._parents:
+            self._parents[key] = parent_map(module.tree)
+        return self._parents[key]
+
+    def enclosing_function(
+        self, module: ModuleInfo, node: ast.AST
+    ) -> Optional[FunctionNode]:
+        """The innermost function/lambda containing ``node``, or None."""
+        parents = self.parents(module)
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, _FUNCTION_NODES):
+                return cur
+            cur = parents.get(cur)
+        return None
+
+    def functions_of(self, module: ModuleInfo) -> List[FunctionInfo]:
+        """The graph's FunctionInfos defined in ``module``."""
+        return [
+            fi for fi in self.graph.functions.values() if fi.module is module
+        ]
+
+    # -- call-site argument binding ---------------------------------------
+
+    @staticmethod
+    def bind_args(call: ast.Call, fi: FunctionInfo) -> Dict[str, ast.expr]:
+        """param name -> argument expression for a resolvable call site.
+
+        Bound-method calls (``self.helper(...)``, any ``Class.method``
+        target called through an attribute) skip the ``self``/``cls``
+        slot. ``*args``/``**kwargs`` at the call site end the positional
+        mapping (unknown arity beyond that point)."""
+        params = lambda_or_def_params(fi.node)
+        if (
+            params
+            and params[0] in ("self", "cls")
+            and "." in fi.qualname
+            and isinstance(call.func, ast.Attribute)
+        ):
+            params = params[1:]
+        bound: Dict[str, ast.expr] = {}
+        for pos, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred) or pos >= len(params):
+                break
+            bound[params[pos]] = arg
+        for kw in call.keywords:
+            if kw.arg is not None:
+                bound[kw.arg] = kw.value
+        return bound
+
+    # -- interprocedural env reads (knob-contract) -------------------------
+
+    def env_reads(self) -> List[EnvRead]:
+        """Every literal env-name read in the project, direct or through a
+        helper whose parameter is the key (``_env("TIP_X", ...)`` counts as
+        a read of ``TIP_X`` at the call site). Computed once per run."""
+        if self._env_reads is not None:
+            return self._env_reads
+        reads: List[EnvRead] = []
+        summaries: Dict[int, _FnSummary] = {}
+
+        # pass 1: direct reads; params used as keys seed the summaries
+        for module in self.modules:
+            aliases = self.aliases(module)
+            environ_names = self.environ_names(module)
+            for node in ast.walk(module.tree):
+                key = env_read_key(node, aliases, environ_names)
+                if key is None:
+                    continue
+                literal = self.graph.resolve_string(module, key)
+                if literal is not None:
+                    reads.append(
+                        EnvRead(module=module, line=node.lineno, env=literal)
+                    )
+                    continue
+                if isinstance(key, ast.Name):
+                    fn = self.enclosing_function(module, node)
+                    if fn is not None and key.id in lambda_or_def_params(fn):
+                        summaries.setdefault(id(fn), _FnSummary()).env_params.add(
+                            key.id
+                        )
+
+        seen_calls: Set[Tuple[int, str]] = set()
+
+        # pass 1b: closure helpers — a nested def is not a project-graph
+        # function, so calls to a summarized local helper are resolved by
+        # name inside the enclosing function's own scope
+        # (``_num("TIP_BREAKER_THRESHOLD", 2)`` inside ``from_env``).
+        # A key that is the *outer* function's parameter seeds the outer
+        # summary, feeding the graph-wide fixed point below.
+        for module in self.modules:
+            for outer in ast.walk(module.tree):
+                if not isinstance(
+                    outer, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                helpers = {
+                    name: fn
+                    for name, fn in nested_defs(outer).items()
+                    if id(fn) in summaries and summaries[id(fn)].env_params
+                }
+                if not helpers:
+                    continue
+                outer_params = lambda_or_def_params(outer)
+                for stmt in outer.body:
+                    for node in scope_walk(stmt):
+                        if not (
+                            isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Name)
+                            and node.func.id in helpers
+                        ):
+                            continue
+                        helper = helpers[node.func.id]
+                        params = lambda_or_def_params(helper)
+                        bound: Dict[str, ast.expr] = {}
+                        for pos, arg in enumerate(node.args):
+                            if isinstance(arg, ast.Starred) or pos >= len(
+                                params
+                            ):
+                                break
+                            bound[params[pos]] = arg
+                        for kw in node.keywords:
+                            if kw.arg is not None:
+                                bound[kw.arg] = kw.value
+                        for param in sorted(
+                            summaries[id(helper)].env_params
+                        ):
+                            arg = bound.get(param)
+                            if arg is None:
+                                continue
+                            literal = self.graph.resolve_string(module, arg)
+                            if literal is not None:
+                                mark = (id(node), literal)
+                                if mark not in seen_calls:
+                                    seen_calls.add(mark)
+                                    reads.append(
+                                        EnvRead(
+                                            module=module,
+                                            line=node.lineno,
+                                            env=literal,
+                                            via=node.func.id,
+                                        )
+                                    )
+                            elif (
+                                isinstance(arg, ast.Name)
+                                and arg.id in outer_params
+                            ):
+                                summaries.setdefault(
+                                    id(outer), _FnSummary()
+                                ).env_params.add(arg.id)
+
+        # pass 2: propagate key-parameters through call sites to a fixed
+        # point, recording literal arguments as reads where they are passed
+        for _ in range(6):
+            changed = False
+            for module in self.modules:
+                for fi in self.functions_of(module):
+                    for call, callee in self.graph.calls_from(module, fi.node):
+                        summary = summaries.get(id(callee.node))
+                        if summary is None or not summary.env_params:
+                            continue
+                        bound = self.bind_args(call, callee)
+                        for param in sorted(summary.env_params):
+                            arg = bound.get(param)
+                            if arg is None:
+                                continue
+                            literal = self.graph.resolve_string(module, arg)
+                            if literal is not None:
+                                mark = (id(call), literal)
+                                if mark not in seen_calls:
+                                    seen_calls.add(mark)
+                                    reads.append(
+                                        EnvRead(
+                                            module=module,
+                                            line=call.lineno,
+                                            env=literal,
+                                            via=callee.dotted,
+                                        )
+                                    )
+                                    changed = True
+                            elif isinstance(arg, ast.Name) and arg.id in (
+                                lambda_or_def_params(fi.node)
+                            ):
+                                s = summaries.setdefault(
+                                    id(fi.node), _FnSummary()
+                                )
+                                if arg.id not in s.env_params:
+                                    s.env_params.add(arg.id)
+                                    changed = True
+            if not changed:
+                break
+        self._env_reads = reads
+        return reads
+
+    # -- interprocedural seed summaries (unsafe-bus-write) -----------------
+
+    def seeded_return_summaries(self, seed_for: Callable[[ModuleInfo], SeedFn]) -> Dict[int, bool]:
+        """id(FunctionNode) -> "its return value is tainted by in-body
+        seeds", iterated so seeded helpers taint their callers' returns.
+
+        ``seed_for(module)`` builds the per-module seed callback (seeds are
+        alias-dependent). Argument pass-through needs no summary: the taint
+        engine already taints any call whose argument is tainted."""
+        summaries: Dict[int, bool] = {}
+        for _ in range(4):
+            changed = False
+            for module in self.modules:
+                seed = seed_for(module)
+                aliases = self.aliases(module)
+                for fi in self.functions_of(module):
+                    if summaries.get(id(fi.node)):
+                        continue
+
+                    def call_effect(call, _arg_taint, _module=module):
+                        name = callee_name(call, self.aliases(_module))
+                        target = (
+                            self.graph.resolve_function(_module, name)
+                            if name
+                            else None
+                        )
+                        if target is not None and summaries.get(id(target.node)):
+                            return Taint(
+                                chain=((call.lineno, f"{name}() returns bus path"),)
+                            )
+                        return None
+
+                    body = (
+                        fi.node.body
+                        if isinstance(fi.node.body, list)
+                        else [ast.Expr(value=fi.node.body)]
+                    )
+                    env = TaintEnv(body, aliases, seed, call_effect)
+                    for stmt in body:
+                        for node in ast.walk(stmt):
+                            if isinstance(node, ast.Return) and node.value is not None:
+                                if env.expr_taint(node.value) is not None:
+                                    summaries[id(fi.node)] = True
+                                    changed = True
+                                    break
+                        if summaries.get(id(fi.node)):
+                            break
+            if not changed:
+                break
+        return summaries
+
+
+#: (module list, flow) of the most recent build — the same identity cache
+#: discipline as graph.project_graph, so the four dataflow rules share one
+#: ProjectFlow (and its memoized FunctionFlows) per analyzer run.
+_LAST_FLOW: Optional[Tuple[Sequence[ModuleInfo], ProjectFlow]] = None
+
+
+def project_flow(modules: Sequence[ModuleInfo]) -> ProjectFlow:
+    """The per-run cached ProjectFlow for a module set."""
+    global _LAST_FLOW
+    if _LAST_FLOW is None or _LAST_FLOW[0] is not modules:
+        _LAST_FLOW = (modules, ProjectFlow(modules))
+    return _LAST_FLOW[1]
+
+
+# ---------------------------------------------------------------------------
+# shared helpers for the dataflow rules
+# ---------------------------------------------------------------------------
+
+
+def iter_function_nodes(tree: ast.Module) -> Iterator[FunctionNode]:
+    """Every def/lambda in a module, outermost first."""
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNCTION_NODES):
+            yield node
+
+
+_BUS_SEGMENT_RE = re.compile(
+    r"(?:^|/)(journal|sa_fit_cache|program_cache|leases|heartbeats)(?:/|$)"
+    r"|(?:^|/)(runs\.jsonl|index\.jsonl|manifest\.json)$"
+)
+
+_BUS_IDENT_RE = re.compile(
+    r"journal|sa_fit|sa_cache|program_cache|lease|heartbeat"
+    r"|manifest_path|rows_path|index_dir"
+)
+
+#: Env vars that *are* a shared-bus root: a path read from one of these is
+#: bus-derived by definition.
+BUS_ENV_VARS = frozenset(
+    {
+        "TIP_JOURNAL",
+        "TIP_SA_CACHE_DIR",
+        "TIP_PROGRAM_CACHE_DIR",
+        "TIP_OBS_INDEX",
+        "TIP_COV_STATS_CACHE_DIR",
+        "TIP_BREAKER_STATE",
+        "TIP_FLEET_HOST",
+    }
+)
+
+
+def bus_seed(module: ModuleInfo, flow: ProjectFlow) -> SeedFn:
+    """The unsafe-bus-write seed callback for one module: env reads of bus
+    roots, path literals with a bus segment, and identifiers that *name* a
+    bus artifact (``manifest_path``, ``self.journal``, ...)."""
+    aliases = flow.aliases(module)
+    environ_names = flow.environ_names(module)
+
+    def seed(node: ast.AST) -> Optional[str]:
+        key = env_read_key(node, aliases, environ_names)
+        if key is not None:
+            literal = flow.graph.resolve_string(module, key)
+            if literal in BUS_ENV_VARS:
+                return f"bus root ${literal}"
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            m = _BUS_SEGMENT_RE.search(node.value.replace("\\", "/"))
+            if m:
+                seg = m.group(1) or m.group(2)
+                return f"bus path literal {node.value!r} ({seg})"
+        ident = None
+        if isinstance(node, ast.Name):
+            ident = node.id
+        elif isinstance(node, ast.Attribute):
+            ident = node.attr
+        if ident is not None and _BUS_IDENT_RE.fullmatch(ident) is None:
+            # full-identifier heuristics only for exact bus names; substring
+            # matches (e.g. `release_fn`) would be noise
+            if _BUS_IDENT_RE.search(ident) and (
+                ident.endswith(("_path", "_dir", "_file"))
+                or ident in ("journal", "lease", "heartbeat")
+            ):
+                return f"bus artifact `{ident}`"
+            return None
+        if ident is not None:
+            return f"bus artifact `{ident}`"
+        return None
+
+    return seed

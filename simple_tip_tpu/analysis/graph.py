@@ -125,6 +125,8 @@ class _ModuleIndex:
     constants: Dict[str, str] = field(default_factory=dict)  # NAME -> "str"
     defs: Dict[str, FunctionInfo] = field(default_factory=dict)  # by qualname
     jit_local: Set[FunctionNode] = field(default_factory=set)
+    # id(method node) -> enclosing class name, for self.method() resolution
+    method_class: Dict[int, str] = field(default_factory=dict)
 
 
 def module_dotted_name(module: ModuleInfo, package_roots: Set[str]) -> str:
@@ -173,6 +175,8 @@ class ProjectGraph:
                 )
                 idx.defs.setdefault(qualname, fi)
                 self.functions.setdefault(fi.dotted, fi)
+                if "." in qualname:
+                    idx.method_class[id(node)] = qualname.split(".")[0]
 
         # Second pass: needs the full function index for target resolution.
         for m in modules:
@@ -349,6 +353,10 @@ class ProjectGraph:
         idx = self._by_module[id(module)]
         if "." not in name:
             return idx.defs.get(name)
+        # Module-local "Class.meth" qualname (the self-call resolution path).
+        local = idx.defs.get(name)
+        if local is not None:
+            return local
         # Fully-qualified: "pkg.mod.fn" or "pkg.mod.Class.fn".
         fi = self.functions.get(name)
         if fi is not None:
@@ -365,11 +373,14 @@ class ProjectGraph:
     ) -> Iterator[Tuple[ast.Call, FunctionInfo]]:
         """Resolvable project-internal call edges out of ``fn``'s body.
 
-        Covers direct calls (``helper(...)``, ``mod.helper(...)``) and
+        Covers direct calls (``helper(...)``, ``mod.helper(...)``),
         ``functools.partial(helper, ...)`` references — a partial built
-        inside traced code executes its target under the same trace.
+        inside traced code executes its target under the same trace — and
+        ``self.method(...)`` calls, resolved against the enclosing class
+        of ``fn`` when ``fn`` is one of its methods.
         """
         idx = self._by_module[id(module)]
+        own_class = idx.method_class.get(id(fn))
         seen: Set[Tuple[int, int]] = set()
         for node in function_body_nodes(fn):
             if not isinstance(node, ast.Call):
@@ -378,6 +389,13 @@ class ProjectGraph:
             candidates: Set[str] = set()
             if name is not None and name not in TRANSFORM_CALLEES:
                 candidates.add(name)
+            if (
+                own_class is not None
+                and name is not None
+                and name.startswith("self.")
+                and name.count(".") == 1
+            ):
+                candidates.add(f"{own_class}.{name[len('self.'):]}")
             if name in ("functools.partial", "partial") and node.args:
                 sub, _ = callable_targets(node.args[0], idx.aliases, idx.bindings)
                 candidates = sub

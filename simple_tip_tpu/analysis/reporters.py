@@ -1,4 +1,4 @@
-"""tiplint output formats: human text, machine JSON and GitHub annotations.
+"""tiplint output formats: text, JSON, GitHub annotations and SARIF.
 
 All reporters consume the full finding list (suppressed findings included)
 so suppression debt stays visible in every report.
@@ -78,7 +78,92 @@ def github_report(findings: Iterable[Finding]) -> str:
     return "\n".join(lines)
 
 
-REPORTERS = {"text": text_report, "json": json_report, "github": github_report}
+#: Synthetic finding kinds the driver emits without a registered Rule.
+_SYNTHETIC_RULES = {
+    "parse-error": "the file could not be parsed; nothing else was checked",
+    "unused-suppression": (
+        "a tiplint disable comment matched no finding; delete the stale "
+        "comment or fix the rule name"
+    ),
+}
+
+
+def sarif_report(findings: Iterable[Finding]) -> str:
+    """SARIF 2.1.0 document (GitHub code scanning ingests this via
+    ``codeql-action/upload-sarif``, so findings land in the Security tab
+    and annotate PRs natively). Suppressed findings are carried with a
+    ``suppressions`` entry (kind ``inSource``) instead of being dropped —
+    the same debt-stays-visible contract as every other reporter. Output
+    is deterministic for fixed input (sorted keys, no timestamps)."""
+    from simple_tip_tpu.analysis.core import all_rules
+
+    findings = list(findings)
+    rule_ids = sorted(
+        {f.rule for f in findings}
+        | set(all_rules())
+        | set(_SYNTHETIC_RULES)
+    )
+    descriptions = {
+        name: rule.description for name, rule in all_rules().items()
+    }
+    descriptions.update(_SYNTHETIC_RULES)
+    rules = [
+        {
+            "id": rid,
+            "shortDescription": {"text": descriptions.get(rid, rid)},
+        }
+        for rid in rule_ids
+    ]
+    index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": "note" if f.suppressed else "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": max(1, f.line)},
+                    }
+                }
+            ],
+        }
+        if f.suppressed:
+            result["suppressions"] = [{"kind": "inSource"}]
+        results.append(result)
+    doc = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+            "Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tiplint",
+                        "informationUri": (
+                            "https://github.com/simple-tip-tpu/simple-tip-tpu"
+                        ),
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+REPORTERS = {
+    "text": text_report,
+    "json": json_report,
+    "github": github_report,
+    "sarif": sarif_report,
+}
 
 
 def render(findings: List[Finding], fmt: str) -> str:

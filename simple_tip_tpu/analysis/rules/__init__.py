@@ -12,11 +12,13 @@ from simple_tip_tpu.analysis.rules import (  # noqa: F401
     blocking_endpoint,
     buffer_donation,
     docstring_coverage,
+    escaping_tracer,
     f64_on_tpu,
     hardcoded_knob,
     host_sync,
     implicit_transfer,
     jit_purity,
+    knob_contract,
     naked_retry,
     prng_hygiene,
     retrace_risk,
@@ -24,6 +26,8 @@ from simple_tip_tpu.analysis.rules import (  # noqa: F401
     sharding_spec,
     transitive_purity,
     unfenced_claim,
+    unsafe_bus_write,
     unversioned_schema,
+    use_after_donate,
     wallclock_duration,
 )

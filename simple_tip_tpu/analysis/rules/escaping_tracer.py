@@ -1,0 +1,161 @@
+"""Rule ``escaping-tracer``: traced values must not outlive the trace.
+
+Inside a jit/shard_map/scan trace every parameter-derived (or jnp-built)
+value is a Tracer, not an array. Stashing one somewhere that survives the
+trace — a module global, an enclosing function's cell via ``nonlocal``, a
+``self.`` attribute — is the classic JAX leak: at best
+``UnexpectedTracerError`` on the next touch, at worst a silently stale
+concrete value baked in from trace time (the cache "works" until shapes
+or weights change). The side effect also silently disappears on retrace,
+so even host-side bookkeeping written this way is wrong.
+
+Traced bodies come from the project graph: locally jit-reachable
+functions *plus* functions traced from another module (a shard_map or
+``pallas_call`` boundary elsewhere). Taintedness is dataflow
+(``analysis/dataflow.py``): parameters seed the taint, jax/jnp call
+results count as traced values, assignment chains propagate with
+provenance — so the finding message renders the chain from the traced
+parameter to the escaping store. Constant stores (``self.calls += 1`` on
+a plain int, ``self.debug = True``) stay clean: only tainted values flag.
+"""
+
+import ast
+from typing import Dict, Iterator, Optional, Sequence, Set, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.common import (
+    FunctionNode,
+    callee_name,
+    lambda_or_def_params,
+)
+
+
+def _jax_seed(aliases: Dict[str, str]):
+    """Seed callback: jax/jnp call results are traced values under trace."""
+
+    def seed(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Call):
+            name = callee_name(node, aliases)
+            if name and (name == "jax" or name.startswith("jax.")):
+                return f"`{name}(...)` result"
+        return None
+
+    return seed
+
+
+@register
+class EscapingTracerRule(Rule):
+    """Flag traced values stored where they outlive the trace."""
+
+    name = "escaping-tracer"
+    description = (
+        "a traced-context value (parameter-derived or jnp-built) is "
+        "assigned to a module global, a nonlocal cell, or a self. "
+        "attribute inside a traced function: the Tracer outlives the "
+        "trace (UnexpectedTracerError, or a silently stale value baked "
+        "in at trace time)"
+    )
+
+    def check_package(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Tuple[str, int, str]]:
+        """Taint-check every store in every traced function body."""
+        # Deferred imports: analysis.graph/.dataflow import rules.common, so
+        # module-level imports here would cycle through rules/__init__
+        # (same pattern as sharding_spec).
+        from simple_tip_tpu.analysis.dataflow import project_flow
+        from simple_tip_tpu.analysis.graph import project_graph
+
+        graph = project_graph(modules)
+        traced: Dict[int, Set[FunctionNode]] = {}
+        how: Dict[int, str] = {}
+        for m in modules:
+            traced[id(m)] = set(graph.jit_reachable(m))
+        for fi, boundary in graph.traced_entries():
+            traced.setdefault(id(fi.module), set()).add(fi.node)
+            if boundary is not None:
+                how[id(fi.node)] = (
+                    f"traced via {boundary.transform} at "
+                    f"{boundary.module.relpath}:{boundary.line}"
+                )
+        pf = project_flow(modules)
+        for module in modules:
+            aliases = pf.aliases(module)
+            for fn in sorted(
+                traced.get(id(module), ()), key=lambda f: f.lineno
+            ):
+                if isinstance(fn, ast.Lambda):
+                    continue  # lambdas cannot contain statements that store
+                label = how.get(id(fn), "locally jit-reachable")
+                yield from self._check_fn(module, fn, aliases, label)
+
+    def _check_fn(
+        self,
+        module: ModuleInfo,
+        fn: FunctionNode,
+        aliases: Dict[str, str],
+        traced_how: str,
+    ) -> Iterator[Tuple[str, int, str]]:
+        from simple_tip_tpu.analysis.dataflow import (
+            Taint,
+            TaintEnv,
+            scope_walk,
+        )
+
+        params = {
+            p: Taint(chain=((fn.lineno, f"traced parameter `{p}`"),))
+            for p in lambda_or_def_params(fn)
+            if p not in ("self", "cls")
+        }
+        env = TaintEnv(fn.body, aliases, _jax_seed(aliases), param_taints=params)
+        escapes: Set[str] = set()
+        for stmt in fn.body:
+            for node in scope_walk(stmt):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    escapes.update(node.names)
+        name = getattr(fn, "name", "<lambda>")
+        for stmt in fn.body:
+            for node in scope_walk(stmt):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = [(t, node.value) for t in node.targets]
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets = [(node.target, node.value)]
+                elif isinstance(node, ast.AugAssign):
+                    targets = [(node.target, node.value)]
+                for target, value in targets:
+                    taint = env.expr_taint(value)
+                    if taint is None:
+                        continue
+                    sink = self._escape_sink(target, escapes)
+                    if sink is None:
+                        continue
+                    yield module.path, node.lineno, (
+                        f"traced value escapes `{name}` ({traced_how}) "
+                        f"through {sink}: {taint.render()} -> stored at "
+                        f"line {node.lineno}; the Tracer outlives the "
+                        f"trace — return the value instead of storing it"
+                    )
+
+    @staticmethod
+    def _escape_sink(target: ast.expr, escapes: Set[str]) -> Optional[str]:
+        """A description of the escaping store target, or None if local."""
+        if isinstance(target, ast.Name) and target.id in escapes:
+            return f"global/nonlocal `{target.id}`"
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            return f"attribute `self.{target.attr}`"
+        if isinstance(target, ast.Subscript):
+            base = target.value
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+            ):
+                return f"container `self.{base.attr}[...]`"
+            if isinstance(base, ast.Name) and base.id in escapes:
+                return f"container `{base.id}[...]`"
+        return None

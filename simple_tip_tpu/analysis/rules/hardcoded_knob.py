@@ -37,6 +37,18 @@ def _knob_envs() -> frozenset:
         return frozenset()
 
 
+def _knob_label(env: str) -> str:
+    """``knob '<name>'`` for a registry env var (lazy, same caveat as
+    :func:`_knob_envs`); falls back to the bare env var mid-refactor."""
+    try:
+        from simple_tip_tpu.plan.knobs import knob_for_env
+
+        k = knob_for_env(env)
+        return f"knob {k.name!r}" if k is not None else env
+    except Exception:  # noqa: BLE001 — analyzer availability > one rule
+        return env
+
+
 def _environ_names(tree) -> set:
     """Local names bound to ``os.environ`` (``from os import environ [as e]``)."""
     names = set()
@@ -87,7 +99,8 @@ class HardcodedKnobRule(Rule):
         def hit(lineno, env):
             return "", lineno, (
                 f"{env} is a planner-owned tuning knob "
-                f"(simple_tip_tpu/plan/knobs.py) hardcoded into os.environ "
+                f"({_knob_label(env)}, simple_tip_tpu/plan/knobs.py) "
+                f"hardcoded into os.environ "
                 f"here: the pin silently overrides any active ExecutionPlan "
                 f"and is invisible to `plan explain` — take the value from "
                 f"the plan (or let the operator's shell set it)"

@@ -1,0 +1,150 @@
+"""Rule ``knob-contract``: every TIP_* env read must be declared somewhere.
+
+``hardcoded-knob`` (PR 15) polices the *write* side of the planner
+contract: library code must not pin planner-owned env vars. This rule
+closes the *read* side: a ``TIP_*`` name read from the environment must
+be declared either in the planner's knob registry
+(``plan/knobs.py`` — :func:`~simple_tip_tpu.plan.knobs.knob_for_env`) or
+in :data:`NON_PLANNER_KNOBS` below, the documented allowlist of
+operational (non-search) knobs. An env read satisfying neither is a knob
+nobody can discover: invisible to ``plan explain``, absent from the
+README knob table's source of truth, and one rename away from silently
+reading nothing.
+
+Reads are found by the dataflow layer (``analysis/dataflow.py``):
+``os.environ.get``/``[]``/``setdefault`` and ``os.getenv`` with a
+literal (or module-constant) name, *including interprocedural reads* —
+``_env("TIP_SERVE_INFLIGHT", int, 2)`` counts as a read of
+``TIP_SERVE_INFLIGHT`` at the call site because the helper's parameter
+flows into its env lookup. Dynamically-built names (the ``TIP_RETRY_*``
+scope family) are unresolvable and never flagged. Scripts and tests are
+exempt surfaces (operators and harnesses improvise knobs legitimately).
+"""
+
+from typing import Iterator, Sequence, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.bare_print import _exempt
+
+#: The documented non-planner knob allowlist: operational env vars that are
+#: deliberately NOT in the planner's search space (they select storage
+#: locations, debug surfaces and failure-policy, not performance points).
+#: Grouped by owning subsystem; keep each entry next to its owner.
+NON_PLANNER_KNOBS = frozenset(
+    {
+        # config.py / the artifact bus root
+        "TIP_ASSETS",
+        "TIP_DATA_DIR",
+        "TIP_TMP_SWEEP_AGE_S",
+        # backend/device policy (config.py, utils/devices.py)
+        "TIP_ALLOW_CPU_FALLBACK",
+        "TIP_COMPUTE_DTYPE",
+        "TIP_JAX_CACHE",
+        "TIP_PROFILE_DIR",
+        "TIP_RUN_TIMEOUT_S",
+        "TIP_INT8_PROFILES",
+        "TIP_CAM_BACKEND",
+        "TIP_CASE_STUDY_PROVIDER",
+        # synthetic data scaling (data/synth.py)
+        "TIP_SYNTH_HARDNESS",
+        "TIP_SYNTH_SCALE",
+        # engine caches (engine/sa_prep.py, engine/run_program.py,
+        # ops/coverage_stats.py)
+        "TIP_SA_CACHE_DIR",
+        "TIP_SA_CACHE_MAX_BYTES",
+        "TIP_SA_PIPELINE",
+        "TIP_PROGRAM_CACHE_DIR",
+        "TIP_PROGRAM_CACHE_MAX_BYTES",
+        "TIP_COV_STATS_CACHE_DIR",
+        # resilience plane (journal, breaker, faults, lease fleet)
+        "TIP_JOURNAL",
+        "TIP_JOURNAL_MAX_BYTES",
+        "TIP_BREAKER_STATE",
+        "TIP_BREAKER_THRESHOLD",
+        "TIP_BREAKER_COOLDOWN_S",
+        "TIP_BREAKER_MODE",
+        "TIP_FAULT_PLAN",
+        "TIP_FAULT_STATE",
+        # (TIP_FLEET_HOST is write-only — the fleet stamps it into worker
+        # env; nothing reads it in-package, so it is deliberately absent:
+        # this list covers the read side of the contract only.)
+        "TIP_FLEET_CLOCK_SKEW_S",
+        "TIP_FLEET_STRAGGLER_S",
+        "TIP_FLEET_STRAGGLER_SLACK",
+        "TIP_FLEET_MAX_STANDBYS",
+        # obs plane (obs/__init__.py, obs/store.py, obs/httpd.py)
+        "TIP_OBS_DIR",
+        "TIP_OBS_ROOT",
+        "TIP_OBS_HTTP",
+        "TIP_OBS_INDEX",
+        "TIP_OBS_SAMPLE",
+        "TIP_OBS_MAX_BYTES",
+        "TIP_OBS_MEMPOLL_S",
+        "TIP_OBS_WORKER",
+        "TIP_OBS_PLATFORM",
+        # serving admission control (serving/knobs.py) — the badge bound
+        # TIP_SERVE_MAX_BADGE is planner-owned; these are load-shed policy
+        "TIP_SERVE_SHED_MODE",
+        "TIP_SERVE_QUEUE_BOUND",
+        "TIP_SERVE_MAX_BACKLOG_S",
+        "TIP_SERVE_INFLIGHT",
+        "TIP_SERVE_FLUSH_DEADLINE_MS",
+        # plan plumbing (plan/plan.py): where the plan itself lives — a
+        # location, not a searched knob
+        "TIP_PLAN_FILE",
+        "TIP_PLAN_MEM_BYTES",
+    }
+)
+
+
+def _planner_declared(env: str) -> bool:
+    """Whether the plan/knobs registry owns ``env`` (lazy import: the
+    registry lives in the analyzed package and must not be a hard dep)."""
+    try:
+        from simple_tip_tpu.plan.knobs import knob_for_env
+
+        return knob_for_env(env) is not None
+    except Exception:  # noqa: BLE001 — analyzer availability > one rule
+        return False
+
+
+@register
+class KnobContractRule(Rule):
+    """Flag undeclared TIP_* env reads (not planner, not allowlisted)."""
+
+    name = "knob-contract"
+    description = (
+        "a TIP_* env var is read but declared neither in the planner knob "
+        "registry (plan/knobs.py) nor in the documented non-planner "
+        "allowlist (analysis/rules/knob_contract.py): undiscoverable "
+        "configuration — declare it in one of the two registries "
+        "(interprocedural: helper reads count at the literal call site; "
+        "scripts/tests exempt)"
+    )
+
+    def check_package(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Tuple[str, int, str]]:
+        """Check every literal TIP_* read the dataflow layer resolves."""
+        # Deferred import: analysis.dataflow imports analysis.graph, which
+        # imports rules.common — a module-level import here would cycle
+        # through rules/__init__ (same pattern as sharding_spec).
+        from simple_tip_tpu.analysis.dataflow import project_flow
+
+        pf = project_flow(modules)
+        for read in pf.env_reads():
+            if not read.env.startswith("TIP_"):
+                continue
+            if read.env in NON_PLANNER_KNOBS or _planner_declared(read.env):
+                continue
+            if _exempt(read.module):
+                continue
+            via = f" (through {read.via})" if read.via else ""
+            yield read.module.path, read.line, (
+                f"{read.env} is read from the environment{via} but is "
+                f"neither a planner knob (plan/knobs.py) nor in the "
+                f"documented non-planner allowlist "
+                f"(analysis/rules/knob_contract.py): undeclared knobs are "
+                f"invisible to `plan explain` and to operators — declare "
+                f"it in one of the two registries"
+            )

@@ -1,0 +1,152 @@
+"""Rule ``unsafe-bus-write``: shared-bus paths demand atomic publication.
+
+The artifact bus under ``$TIP_ASSETS`` is multi-process by design: the
+resume journal, the SA fit cache, the AOT program cache, fleet leases/
+heartbeats and the obs feature index are all read and written by
+concurrent workers, bench children and schedulers. The repo's write
+discipline for these files is settled (PR 6/11): either
+``utils/artifacts_io.atomic_write_bytes`` (pid-unique tmp + fsync +
+``os.replace``), the journal's fenced ``O_APPEND`` commit, or a plain
+append whose readers tolerate one torn tail line. A *raw truncating*
+``open(path, "w")`` on a bus path breaks every one of those contracts:
+concurrent readers see a half-written file, and two writers sharing a
+non-unique tmp name publish each other's torn output.
+
+Detection is taint dataflow (``analysis/dataflow.py``): seeds are env
+reads of bus roots (``TIP_JOURNAL``, ``TIP_OBS_INDEX``, ...), path
+literals containing a bus segment (``journal/``, ``sa_fit_cache``,
+``leases``...), and identifiers naming a bus artifact
+(``manifest_path``, ``self.journal``); taint flows through assignments,
+f-strings, ``os.path.join`` and helper returns (interprocedural
+summaries: a function returning a bus-derived path taints its call
+sites). A tainted path reaching ``open(..., "w"/"x"/"+")`` is a finding
+— unless the path is pid-unique (its construction contains
+``os.getpid()``/``mkstemp``/``uuid4``) *and* the function later
+``os.replace``/``os.rename``s it: that is the atomic idiom itself.
+Append mode is exempt (torn-tail-tolerant readers are the append bus
+contract), and ``os.open``-based writers (the journal's fenced commit)
+are out of scope by construction. Scripts and tests are exempt surfaces.
+"""
+
+import ast
+from typing import Iterator, Optional, Sequence, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.bare_print import _exempt
+from simple_tip_tpu.analysis.rules.common import callee_name
+
+_OPEN_NAMES = ("open", "io.open", "builtins.open")
+
+
+def _write_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode string when this ``open`` truncates or creates
+    (``w``/``x``/``+``); None for reads, appends, or dynamic modes."""
+    mode_node: Optional[ast.expr] = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if mode_node is None:
+        return None  # default "r"
+    if not (isinstance(mode_node, ast.Constant) and isinstance(mode_node.value, str)):
+        return None
+    mode = mode_node.value
+    if "a" in mode:
+        return None  # append bus: readers own the torn-tail contract
+    if any(c in mode for c in "wx+"):
+        return mode
+    return None
+
+
+@register
+class UnsafeBusWriteRule(Rule):
+    """Flag raw truncating writes of shared-bus-derived paths."""
+
+    name = "unsafe-bus-write"
+    description = (
+        "a path derived from a shared-bus root (journal, sa_fit_cache, "
+        "program cache, leases, obs index) reaches a raw truncating "
+        "open() instead of atomic_write_bytes or the pid-unique "
+        "tmp + os.replace idiom: concurrent readers see a half-written "
+        "file and racing writers collide (scripts/tests exempt)"
+    )
+
+    def check_package(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Tuple[str, int, str]]:
+        """Taint every function body, flag tainted truncating opens."""
+        # Deferred import: analysis.dataflow imports analysis.graph, which
+        # imports rules.common — a module-level import here would cycle
+        # through rules/__init__ (same pattern as sharding_spec).
+        from simple_tip_tpu.analysis.dataflow import (
+            Taint,
+            TaintEnv,
+            bus_seed,
+            iter_function_nodes,
+            project_flow,
+            scope_walk,
+        )
+
+        pf = project_flow(modules)
+        summaries = pf.seeded_return_summaries(lambda m: bus_seed(m, pf))
+        for module in modules:
+            if _exempt(module):
+                continue
+            aliases = pf.aliases(module)
+            seed = bus_seed(module, pf)
+
+            def call_effect(call, _arg_taint, _module=module):
+                name = callee_name(call, aliases)
+                fi = pf.graph.resolve_function(_module, name) if name else None
+                if fi is not None and summaries.get(id(fi.node)):
+                    return Taint(
+                        chain=((call.lineno, f"{name}() returns a bus path"),)
+                    )
+                return None
+
+            for fn in iter_function_nodes(module.tree):
+                body = fn.body if isinstance(fn.body, list) else None
+                if body is None:
+                    continue  # lambda bodies can't open-and-write usefully
+                env = TaintEnv(body, aliases, seed, call_effect)
+                has_replace = self._has_replace(body, aliases)
+                for stmt in body:
+                    for node in scope_walk(stmt):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        if callee_name(node, aliases) not in _OPEN_NAMES:
+                            continue
+                        if not node.args:
+                            continue
+                        mode = _write_mode(node)
+                        if mode is None:
+                            continue
+                        taint = env.expr_taint(node.args[0])
+                        if taint is None:
+                            continue
+                        if taint.pid_unique and has_replace:
+                            continue  # the atomic tmp+replace idiom itself
+                        yield module.path, node.lineno, (
+                            f"shared-bus path reaches a raw "
+                            f"open(..., {mode!r}): {taint.render()} -> "
+                            f"open at line {node.lineno}; concurrent "
+                            f"readers can see the file half-written and "
+                            f"racing writers collide — use "
+                            f"utils/artifacts_io.atomic_write_bytes, or "
+                            f"a pid-unique tmp "
+                            f'(f"{{path}}.{{os.getpid()}}.tmp") + fsync '
+                            f"+ os.replace"
+                        )
+
+    @staticmethod
+    def _has_replace(body, aliases) -> bool:
+        from simple_tip_tpu.analysis.dataflow import scope_walk
+
+        for stmt in body:
+            for node in scope_walk(stmt):
+                if isinstance(node, ast.Call) and callee_name(
+                    node, aliases
+                ) in ("os.replace", "os.rename", "shutil.move"):
+                    return True
+        return False

@@ -1,0 +1,238 @@
+"""Rule ``use-after-donate``: a donated buffer must never be read again.
+
+``donate_argnums`` hands the argument's buffer to XLA: after the dispatch
+the caller-side array is *deleted* on TPU — touching it raises (at best)
+or aliases freshly-written memory (at worst, and only on device, so the
+CPU tier-1 suite never sees it). The ``buffer-donation`` rule pushes code
+*toward* donation; this rule catches the resulting footgun: a value
+passed at a donated position that some execution path reads again before
+rebinding it.
+
+Detection is flow-sensitive (``analysis/dataflow.py``): every donating
+jit application is resolved to its literal donated positions —
+
+- ``@partial(jax.jit, donate_argnums=...)`` decorated defs,
+- ``step = jax.jit(f, donate_argnums=...)`` / ``partial(jax.jit,
+  donate_argnums=...)(f)`` local bindings,
+- ``self._step = jax.jit(...)`` class-attribute bindings called through
+  ``self._step(...)``,
+- factory functions whose return statement *is* a donating application
+  (the ``make_jitted_epoch`` pattern in models/train.py), resolved through
+  the project graph so cross-module factories count —
+
+then every call of a donating callable seeds the donated argument names
+as poison in the enclosing function's CFG, killed by redefinition, and
+any reaching read is a finding. The loop back edge matters: an un-rebound
+state threaded around a ``for`` is read again on iteration two. Dynamic
+``donate_argnums`` expressions (``_donate(1)``) are unknown, never
+flagged. The finding message renders the chain: jit bind site → dispatch
+→ violating read.
+"""
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+from simple_tip_tpu.analysis.rules.buffer_donation import _JIT_NAMES
+from simple_tip_tpu.analysis.rules.common import (
+    callee_name,
+    is_partial_of,
+)
+
+
+def _donate_positions(keywords: List[ast.keyword]) -> Optional[Tuple[int, ...]]:
+    """Literal donated positions, or None (absent / dynamic = unknown)."""
+    for kw in keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in v.elts
+            ):
+                return tuple(e.value for e in v.elts)
+            return None
+    return None
+
+
+def _donating_application(
+    call: ast.Call, aliases: Dict[str, str]
+) -> Optional[Tuple[int, ...]]:
+    """Donated positions when ``call`` applies jit with literal donation:
+    ``jax.jit(f, donate_argnums=...)`` or ``partial(jax.jit, ...)(f)``."""
+    name = callee_name(call, aliases)
+    if name in _JIT_NAMES and call.args:
+        return _donate_positions(call.keywords)
+    if isinstance(call.func, ast.Call) and call.args:
+        for jit in _JIT_NAMES:
+            if is_partial_of(call.func, jit, aliases):
+                return _donate_positions(call.func.keywords)
+    return None
+
+
+def _decorator_positions(
+    fn: ast.AST, aliases: Dict[str, str]
+) -> Optional[Tuple[int, ...]]:
+    """Donated positions a jit decorator declares on ``fn``, or None."""
+    for dec in getattr(fn, "decorator_list", []):
+        if not isinstance(dec, ast.Call):
+            continue
+        if callee_name(dec, aliases) in _JIT_NAMES:
+            pos = _donate_positions(dec.keywords)
+            if pos:
+                return pos
+        for jit in _JIT_NAMES:
+            if is_partial_of(dec, jit, aliases):
+                pos = _donate_positions(dec.keywords)
+                if pos:
+                    return pos
+    return None
+
+
+#: A donor: donated positions + where the jit binding happened (for the
+#: chain rendering in the finding message).
+Donor = Tuple[Tuple[int, ...], int]
+
+
+@register
+class UseAfterDonateRule(Rule):
+    """Flag reads of a value after it was passed at a donated position."""
+
+    name = "use-after-donate"
+    description = (
+        "a value passed at a donate_argnums position of a jit'd callable "
+        "is read again on some path after the dispatch: donation deletes "
+        "the buffer on TPU, so the read raises or aliases garbage — "
+        "rebind the result or pass a copy (flow-sensitive; dynamic "
+        "donate_argnums are never flagged)"
+    )
+
+    def check_package(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Tuple[str, int, str]]:
+        """Per module: collect donating callables, then poison-check every
+        dispatch of one inside every function body."""
+        # Deferred import: analysis.dataflow imports analysis.graph, which
+        # imports rules.common — a module-level import here would cycle
+        # through rules/__init__ (same pattern as sharding_spec).
+        from simple_tip_tpu.analysis.dataflow import project_flow
+
+        pf = project_flow(modules)
+        factories = self._factories(modules, pf)
+        for module in modules:
+            donors = self._donors(module, pf, factories)
+            if not donors:
+                continue
+            yield from self._check_dispatches(module, pf, donors)
+
+    # -- donor collection --------------------------------------------------
+
+    def _factories(self, modules, pf) -> Dict[int, Tuple[int, ...]]:
+        """id(def node) -> donated positions, for functions whose return
+        value is a donating jit application (jit factories)."""
+        from simple_tip_tpu.analysis.dataflow import (
+            iter_function_nodes,
+            scope_walk,
+        )
+
+        out: Dict[int, Tuple[int, ...]] = {}
+        for module in modules:
+            aliases = pf.aliases(module)
+            for fn in iter_function_nodes(module.tree):
+                if isinstance(fn, ast.Lambda):
+                    continue
+                for stmt in scope_walk(fn):
+                    if not (
+                        isinstance(stmt, ast.Return)
+                        and isinstance(stmt.value, ast.Call)
+                    ):
+                        continue
+                    pos = _donating_application(stmt.value, aliases)
+                    if pos:
+                        out[id(fn)] = pos
+                        break
+        return out
+
+    def _donors(self, module, pf, factories) -> Dict[str, Donor]:
+        """callable name (``step`` / ``self._step``) -> donor record."""
+        from simple_tip_tpu.analysis.dataflow import iter_function_nodes
+
+        aliases = pf.aliases(module)
+        donors: Dict[str, Donor] = {}
+        for fn in iter_function_nodes(module.tree):
+            if isinstance(fn, ast.Lambda):
+                continue
+            pos = _decorator_positions(fn, aliases)
+            if pos:
+                donors[fn.name] = (pos, fn.lineno)
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            target = node.targets[0]
+            name = None
+            if isinstance(target, ast.Name):
+                name = target.id
+            elif (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                name = f"self.{target.attr}"
+            if name is None or not isinstance(node.value, ast.Call):
+                continue
+            pos = _donating_application(node.value, aliases)
+            if pos is None:
+                # a call to a jit factory also binds a donating callable
+                callee = callee_name(node.value, aliases)
+                fi = pf.graph.resolve_function(module, callee) if callee else None
+                if fi is not None:
+                    pos = factories.get(id(fi.node))
+            if pos:
+                donors.setdefault(name, (pos, node.lineno))
+        return donors
+
+    # -- dispatch poison check ---------------------------------------------
+
+    def _check_dispatches(self, module, pf, donors):
+        from simple_tip_tpu.analysis.dataflow import (
+            iter_function_nodes,
+            scope_walk,
+        )
+
+        aliases = pf.aliases(module)
+        for fn in iter_function_nodes(module.tree):
+            if isinstance(fn, ast.Lambda):
+                continue
+            flow = None
+            for node in scope_walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = callee_name(node, aliases)
+                donor = donors.get(name) if name else None
+                if donor is None:
+                    continue
+                positions, bind_line = donor
+                if flow is None:
+                    flow = pf.flow(fn)
+                stmt_idx = flow.statement_of(node)
+                if stmt_idx is None:
+                    continue  # dispatch inside a nested scope's own flow
+                for pos in positions:
+                    if pos >= len(node.args):
+                        continue
+                    arg = node.args[pos]
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    if arg.id in flow.writes(stmt_idx):
+                        continue  # `x, y = step(x, y)` rebinds: poison dies
+                    for use in flow.reaching_uses(stmt_idx, arg.id):
+                        yield module.path, use.lineno, (
+                            f"`{arg.id}` is read here after being donated: "
+                            f"jit bound with donate_argnums at line "
+                            f"{bind_line} -> `{name}(...)` dispatch at line "
+                            f"{node.lineno} donates argument {pos} "
+                            f"(`{arg.id}`) -> read at line {use.lineno} "
+                            f"touches a deleted buffer on TPU; rebind the "
+                            f"result over `{arg.id}` or pass a copy"
+                        )

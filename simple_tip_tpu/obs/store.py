@@ -552,9 +552,13 @@ def refresh(roots, index_dir=None) -> dict:
                 "indexed_unix": round(time.time(), 1),
             }
             indexed.append(path)
-    tmp = manifest_path + ".tmp"
+    # pid-unique tmp + fsync before the replace: two indexers racing on a
+    # shared ".tmp" would publish each other's torn manifest.
+    tmp = f"{manifest_path}.{os.getpid()}.tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, manifest_path)
     return {
         "index": rows_path,

@@ -168,6 +168,20 @@ def planned_env_vars() -> frozenset:
     return frozenset(k.env for k in KNOBS)
 
 
+_BY_ENV: Dict[str, Knob] = {k.env: k for k in KNOBS}
+
+
+def knob_for_env(env: str) -> Optional[Knob]:
+    """The registry knob owning env var ``env``, or None.
+
+    The tiplint dataflow rules consume this export: ``knob-contract``
+    treats a ``TIP_*`` read as declared exactly when this returns a knob
+    (or the name is in the rule's documented non-planner allowlist), and
+    ``hardcoded-knob`` names the owning knob in its finding message.
+    """
+    return _BY_ENV.get(env)
+
+
 def default_assignment() -> Dict[str, object]:
     """The all-defaults knob assignment (the search's starting point)."""
     return {k.name: k.default for k in KNOBS}

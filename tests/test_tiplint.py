@@ -1090,6 +1090,223 @@ os.environ["TIP_NUM_WORKERS"] = "2"
 ''',
 }
 
+BAD_USE_AFTER_DONATE = {
+    "mod.py": '''"""m."""
+import jax
+from functools import partial
+
+
+def step(params, batch):
+    """d."""
+    return params
+
+
+train_step = jax.jit(step, donate_argnums=(0,))
+
+
+def loop(params, batches):
+    """Iteration two reads `params` after iteration one donated it."""
+    for b in batches:
+        loss = train_step(params, b)
+    return loss
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update(state, x):
+    """d."""
+    return state
+
+
+def run(state, x):
+    """Straight-line read after the dispatch donated `state`."""
+    new = update(state, x)
+    print(state.shape)
+    return new
+
+
+def make_epoch():
+    """A jit factory: its return value donates positions 0 and 1."""
+    return partial(jax.jit, donate_argnums=(0, 1))(step)
+
+
+def factory_use(params, opt, batches):
+    """The factory-built callable donates too."""
+    epoch = make_epoch()
+    loss = epoch(params, opt)
+    return params
+'''
+}
+
+GOOD_USE_AFTER_DONATE = {
+    "mod.py": '''"""m."""
+import jax
+
+
+def step(params, batch):
+    """d."""
+    return params
+
+
+train_step = jax.jit(step, donate_argnums=(0,))
+
+
+def loop(params, batches):
+    """Rebinding over the donated name kills the poison."""
+    for b in batches:
+        params = train_step(params, b)
+    return params
+
+
+def dynamic(params, batches, donate):
+    """Dynamic donate_argnums are unknown: never flagged."""
+    f = jax.jit(step, donate_argnums=donate)
+    for b in batches:
+        loss = f(params, b)
+    return loss
+'''
+}
+
+BAD_ESCAPING_TRACER = {
+    "mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def f(x):
+    """A traced value stored into a module global outlives the trace."""
+    global y
+    y = x * 2
+    return y
+
+
+class M:
+    """c."""
+
+    @jax.jit
+    def g(self, x):
+        """A traced value stored onto self outlives the trace."""
+        self.last = jnp.sum(x)
+        return x
+'''
+}
+
+GOOD_ESCAPING_TRACER = {
+    "mod.py": '''"""m."""
+import jax
+
+
+@jax.jit
+def f(x):
+    """Local binding only: nothing escapes."""
+    y = x * 2
+    return y
+
+
+class M:
+    """c."""
+
+    def host_setup(self, x):
+        """Not traced: self-attribute stores are ordinary host code."""
+        self.last = x
+'''
+}
+
+BAD_UNSAFE_BUS_WRITE = {
+    "mod.py": '''"""m."""
+import json
+import os
+
+
+def write_manifest(index_dir):
+    """Non-pid tmp on a bus artifact: racing writers collide."""
+    manifest_path = os.path.join(index_dir, "manifest.json")
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({}, f)
+    os.replace(tmp, manifest_path)
+
+
+def journal_root():
+    """d."""
+    return os.environ.get("TIP_JOURNAL", "journal/runs.jsonl")
+
+
+def rewrite(rec):
+    """A helper-returned bus path reaching open(w) is interprocedural."""
+    path = journal_root()
+    with open(path, "w") as f:
+        f.write(json.dumps(rec))
+'''
+}
+
+GOOD_UNSAFE_BUS_WRITE = {
+    "mod.py": '''"""m."""
+import json
+import os
+
+
+def write_manifest(index_dir):
+    """The atomic idiom itself: pid-unique tmp + fsync + replace."""
+    manifest_path = os.path.join(index_dir, "manifest.json")
+    tmp = f"{manifest_path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, manifest_path)
+
+
+def append_row(journal_path, rec):
+    """Append mode: the torn-tail contract belongs to the readers."""
+    with open(journal_path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(rec) + "\\n")
+'''
+}
+
+BAD_KNOB_CONTRACT = {
+    "mod.py": '''"""m."""
+import os
+
+
+def poll_interval():
+    """A TIP_* read declared in neither registry."""
+    return float(os.environ.get("TIP_SECRET_POLL_S", "5"))
+
+
+def _env(var, cast, default):
+    """d."""
+    raw = os.environ.get(var)
+    return cast(raw) if raw else default
+
+
+def inflight():
+    """The helper read counts at this literal call site."""
+    return _env("TIP_SECRET_INFLIGHT", int, 2)
+'''
+}
+
+GOOD_KNOB_CONTRACT = {
+    "mod.py": '''"""m."""
+import os
+
+
+def assets():
+    """Allowlisted in NON_PLANNER_KNOBS."""
+    return os.environ.get("TIP_ASSETS", "")
+
+
+def batch():
+    """Declared in the planner registry (plan/knobs.py)."""
+    return int(os.environ.get("TIP_PLAN_BATCH", "8192"))
+
+
+def retry(scope):
+    """Dynamically-built names are unresolvable: never flagged."""
+    return os.environ.get(f"TIP_RETRY_{scope}_MAX", "3")
+'''
+}
+
 FIXTURES = {
     "jit-purity": (BAD_JIT_PURITY, GOOD_JIT_PURITY),
     "hardcoded-knob": (BAD_HARDCODED_KNOB, GOOD_HARDCODED_KNOB),
@@ -1111,6 +1328,10 @@ FIXTURES = {
     "unversioned-schema": (BAD_UNVERSIONED_SCHEMA, GOOD_UNVERSIONED_SCHEMA),
     "blocking-in-async": (BAD_BLOCKING_ASYNC, GOOD_BLOCKING_ASYNC),
     "blocking-endpoint": (BAD_BLOCKING_ENDPOINT, GOOD_BLOCKING_ENDPOINT),
+    "use-after-donate": (BAD_USE_AFTER_DONATE, GOOD_USE_AFTER_DONATE),
+    "escaping-tracer": (BAD_ESCAPING_TRACER, GOOD_ESCAPING_TRACER),
+    "unsafe-bus-write": (BAD_UNSAFE_BUS_WRITE, GOOD_UNSAFE_BUS_WRITE),
+    "knob-contract": (BAD_KNOB_CONTRACT, GOOD_KNOB_CONTRACT),
 }
 
 
@@ -1665,3 +1886,366 @@ def test_whole_project_is_lint_clean():
     assert not findings, "tiplint findings:\n" + "\n".join(
         f.format() for f in findings
     )
+
+
+# --- dataflow rules: chain rendering and flow sensitivity --------------------
+
+
+def test_use_after_donate_covers_all_three_shapes(tmp_path):
+    findings = _run_rule(tmp_path, "use-after-donate", BAD_USE_AFTER_DONATE)
+    blob = " ".join(f.message for f in findings)
+    # loop back edge: `params` read again on iteration two
+    assert "`params` is read here after being donated" in blob
+    # straight-line read after the dispatch
+    assert "`state` is read here after being donated" in blob
+    # the factory-built callable donates too
+    assert "`epoch`(...)" in blob.replace("epoch(", "`epoch`(") or "epoch" in blob
+    # the chain renders bind site -> dispatch -> read
+    assert "jit bound with donate_argnums at line" in blob
+    assert "dispatch at line" in blob
+    assert "touches a deleted buffer on TPU" in blob
+
+
+def test_use_after_donate_rebind_in_same_statement_is_clean(tmp_path):
+    # `params, opt = step(params, opt)` rebinds the donated names in the
+    # dispatch statement itself: the poison must die before any read.
+    files = {
+        "mod.py": '''"""m."""
+import jax
+
+
+def step(params, opt):
+    """d."""
+    return params, opt
+
+
+train = jax.jit(step, donate_argnums=(0, 1))
+
+
+def loop(params, opt, batches):
+    """d."""
+    for _ in batches:
+        params, opt = train(params, opt)
+    return params, opt
+'''
+    }
+    assert not _run_rule(tmp_path, "use-after-donate", files)
+
+
+def test_escaping_tracer_names_sink_and_chain(tmp_path):
+    findings = _run_rule(tmp_path, "escaping-tracer", BAD_ESCAPING_TRACER)
+    blob = " ".join(f.message for f in findings)
+    assert "global/nonlocal `y`" in blob
+    assert "attribute `self.last`" in blob
+    # provenance chain starts at the traced parameter
+    assert "traced parameter `x`" in blob
+    assert "the Tracer outlives the trace" in blob
+
+
+def test_escaping_tracer_cross_module_boundary(tmp_path):
+    # `kernel` is traced from ANOTHER module via shard_map: the stores
+    # inside it must flag, and the message must point at the boundary.
+    files = {
+        "kern.py": '''"""m."""
+import jax.numpy as jnp
+
+_stats = {}
+
+
+def kernel(x):
+    """d."""
+    global total
+    total = jnp.sum(x)
+    return x
+''',
+        "driver.py": '''"""m."""
+from jax.experimental.shard_map import shard_map
+
+from kern import kernel
+
+
+def launch(mesh, x):
+    """d."""
+    return shard_map(kernel, mesh=mesh, in_specs=None, out_specs=None)(x)
+''',
+    }
+    findings = _run_rule(tmp_path, "escaping-tracer", files)
+    assert findings, "cross-module traced entry produced no findings"
+    blob = " ".join(f.message for f in findings)
+    assert "traced via" in blob and "shard_map" in blob
+    assert "driver.py:9" in blob  # the boundary site, not the kernel
+
+
+def test_unsafe_bus_write_interprocedural_and_direct(tmp_path):
+    findings = _run_rule(tmp_path, "unsafe-bus-write", BAD_UNSAFE_BUS_WRITE)
+    assert len(findings) == 2, findings
+    blob = " ".join(f.message for f in findings)
+    # the helper-returned journal path taints its call site
+    assert "journal_root() returns a bus path" in blob
+    # the non-pid manifest tmp is named with its provenance
+    assert "manifest_path" in blob
+
+
+def test_unsafe_bus_write_pid_unique_requires_replace(tmp_path):
+    # pid-unique tmp WITHOUT a later os.replace is not the atomic idiom —
+    # it still leaves the published path unwritten.
+    files = {
+        "mod.py": '''"""m."""
+import json
+import os
+
+
+def write_manifest(index_dir):
+    """d."""
+    manifest_path = os.path.join(index_dir, "manifest.json")
+    tmp = f"{manifest_path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({}, f)
+'''
+    }
+    assert _run_rule(tmp_path, "unsafe-bus-write", files)
+
+
+def test_knob_contract_direct_and_through_helper(tmp_path):
+    findings = _run_rule(tmp_path, "knob-contract", BAD_KNOB_CONTRACT)
+    assert len(findings) == 2, findings
+    blob = " ".join(f.message for f in findings)
+    assert "TIP_SECRET_POLL_S" in blob
+    assert "TIP_SECRET_INFLIGHT" in blob
+    assert "(through mod._env)" in blob
+
+
+def test_knob_contract_closure_helper_read_counts(tmp_path):
+    # A read through a nested closure helper (the breaker's `_num` shape)
+    # resolves to the literal name at the call site — allowlisted names
+    # must therefore stay clean, undeclared ones must flag.
+    files = {
+        "mod.py": '''"""m."""
+import os
+
+
+def from_env():
+    """d."""
+
+    def _num(var, default):
+        try:
+            return float(os.environ.get(var, "") or default)
+        except ValueError:
+            return default
+
+    return _num("TIP_SECRET_THRESHOLD", 2)
+'''
+    }
+    findings = _run_rule(tmp_path, "knob-contract", files)
+    assert len(findings) == 1, findings
+    assert "TIP_SECRET_THRESHOLD" in findings[0].message
+    assert "(through _num)" in findings[0].message
+
+
+# --- baseline mode -----------------------------------------------------------
+
+
+def test_baseline_roundtrip_accepts_recorded_debt(tmp_path, capsys):
+    root = str(tmp_path / "pkg")
+    _write(root, "ops/bad.py", '"""m."""\nimport numpy as np\na = np.float64(1)\n')
+    base = str(tmp_path / "base.json")
+    # snapshot the debt, exit 0
+    assert main([root, "--select", "f64-on-tpu", "--write-baseline", base]) == 0
+    capsys.readouterr()
+    # the baselined run passes; the finding renders as suppressed
+    assert main([root, "--select", "f64-on-tpu", "--baseline", base,
+                 "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["summary"]["unsuppressed"] == 0
+    assert doc["summary"]["suppressed"] == 1
+
+
+def test_baseline_is_line_insensitive_but_counts_new_findings(tmp_path, capsys):
+    root = str(tmp_path / "pkg")
+    bad = str(tmp_path / "base.json")
+    _write(root, "ops/bad.py", '"""m."""\nimport numpy as np\na = np.float64(1)\n')
+    assert main([root, "--select", "f64-on-tpu", "--write-baseline", bad]) == 0
+    # shift the finding down two lines: same fingerprint, still covered
+    _write(root, "ops/bad.py",
+           '"""m."""\nimport numpy as np\n\n\na = np.float64(1)\n')
+    assert main([root, "--select", "f64-on-tpu", "--baseline", bad]) == 0
+    # a SECOND occurrence exceeds the accepted count: run fails again
+    _write(root, "ops/bad.py",
+           '"""m."""\nimport numpy as np\na = np.float64(1)\nb = np.float64(2)\n')
+    assert main([root, "--select", "f64-on-tpu", "--baseline", bad]) == 1
+    capsys.readouterr()
+
+
+def test_baseline_bad_file_is_usage_error(tmp_path, capsys):
+    root = str(tmp_path / "pkg")
+    _write(root, "mod.py", '"""m."""\n')
+    bad = str(tmp_path / "notjson.json")
+    with open(bad, "w") as f:
+        f.write("{")
+    assert main([root, "--baseline", bad]) == 2
+    capsys.readouterr()
+
+
+def test_committed_baseline_is_empty_and_loadable():
+    """The repo ships an EMPTY baseline: the sweep is clean, and debt must
+    never silently accumulate into the committed file."""
+    from simple_tip_tpu.analysis.baseline import load_baseline
+
+    accepted = load_baseline(os.path.join(REPO_ROOT, "tiplint_baseline.json"))
+    assert accepted == {}
+
+
+# --- changed-only mode -------------------------------------------------------
+
+
+def _git(cwd, *args):
+    env = dict(os.environ, GIT_CONFIG_GLOBAL=os.devnull,
+               GIT_CONFIG_SYSTEM=os.devnull)
+    proc = subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=cwd, env=env, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+def test_changed_only_scopes_reporting_to_changed_files(tmp_path, capsys):
+    root = str(tmp_path / "repo")
+    # ops/ paths: f64-on-tpu only fires in device-adjacent modules
+    _write(root, "ops/stale.py",
+           '"""m."""\nimport numpy as np\na = np.float64(1)\n')
+    _write(root, "ops/clean.py", '"""m."""\n')
+    _git(root, "init", "-q")
+    _git(root, "add", "-A")
+    _git(root, "commit", "-qm", "seed")
+    # untouched tree: the stale finding is out of scope, run passes
+    assert main([root, "--select", "f64-on-tpu", "--changed-only"]) == 0
+    capsys.readouterr()
+    # a new violation in a CHANGED file is in scope and fails
+    _write(root, "ops/clean.py",
+           '"""m."""\nimport numpy as np\nb = np.float64(2)\n')
+    assert main([root, "--select", "f64-on-tpu", "--changed-only",
+                 "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    paths = {f["path"] for f in doc["findings"]}
+    assert paths == {"ops/clean.py"}, paths
+    # an UNTRACKED file counts as changed too
+    _git(root, "add", "-A")
+    _git(root, "commit", "-qm", "accept")
+    _write(root, "ops/fresh.py",
+           '"""m."""\nimport numpy as np\nc = np.float64(3)\n')
+    assert main([root, "--select", "f64-on-tpu", "--changed-only"]) == 1
+    capsys.readouterr()
+
+
+def test_changed_only_outside_git_is_usage_error(tmp_path, capsys):
+    root = str(tmp_path / "plain")
+    _write(root, "mod.py", '"""m."""\n')
+    env = dict(os.environ, GIT_CONFIG_GLOBAL=os.devnull,
+               GIT_CONFIG_SYSTEM=os.devnull,
+               GIT_CEILING_DIRECTORIES=str(tmp_path))
+    proc = subprocess.run(
+        [sys.executable, "-m", "simple_tip_tpu.analysis", root,
+         "--changed-only"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 2, (proc.stdout, proc.stderr)
+    assert "--changed-only" in proc.stderr
+
+
+def test_changed_only_skips_unused_suppression_audit(tmp_path, capsys):
+    """Satellite fix: a scoped sweep must NOT audit suppressions — a
+    disable comment whose rule fires from an out-of-scope file would be
+    falsely reported stale."""
+    root = str(tmp_path / "repo")
+    # the suppression in changed.py matches a real finding...
+    _write(root, "ops/changed.py",
+           '"""m."""\nimport numpy as np\n'
+           'a = np.float64(1)  # tiplint: disable=f64-on-tpu\n')
+    _git(root, "init", "-q")
+    _git(root, "add", "-A")
+    _git(root, "commit", "-qm", "seed")
+    _write(root, "ops/changed.py",
+           '"""m."""\nimport numpy as np\n\n'
+           'a = np.float64(1)  # tiplint: disable=f64-on-tpu\n')
+    # full run: suppression is used, no unused-suppression finding
+    assert main([root]) == 0
+    capsys.readouterr()
+    # scoped run: still 0 — and crucially no unused-suppression synthetic
+    assert main([root, "--changed-only", "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert not [f for f in doc["findings"]
+                if f["rule"] == "unused-suppression"]
+
+
+# --- findings cache ----------------------------------------------------------
+
+
+def test_cache_replays_byte_identical_and_announces_hit(tmp_path, capsys):
+    root = str(tmp_path / "pkg")
+    _write(root, "ops/bad.py", '"""m."""\nimport numpy as np\na = np.float64(1)\n')
+    cache_dir = str(tmp_path / "cache")
+    args = [root, "--select", "f64-on-tpu", "--cache", cache_dir,
+            "--format", "json"]
+    assert main(args) == 1
+    first = capsys.readouterr()
+    assert "cache hit" not in first.err
+    assert main(args) == 1
+    second = capsys.readouterr()
+    assert second.out == first.out  # byte-identical replay
+    assert "cache hit" in second.err
+
+
+def test_cache_invalidates_on_file_edit(tmp_path, capsys):
+    root = str(tmp_path / "pkg")
+    target = _write(root, "ops/bad.py",
+                    '"""m."""\nimport numpy as np\na = np.float64(1)\n')
+    cache_dir = str(tmp_path / "cache")
+    args = [root, "--select", "f64-on-tpu", "--cache", cache_dir]
+    assert main(args) == 1
+    capsys.readouterr()
+    with open(target, "w") as f:
+        f.write('"""m."""\n')
+    os.utime(target, ns=(1, 1))  # force a distinct mtime_ns
+    assert main(args) == 0
+    out = capsys.readouterr()
+    assert "cache hit" not in out.err
+
+
+# --- SARIF reporter ----------------------------------------------------------
+
+
+def test_sarif_document_shape_and_suppressions(tmp_path, capsys):
+    root = str(tmp_path / "pkg")
+    _write(root, "ops/bad.py",
+           '"""m."""\nimport numpy as np\na = np.float64(1)\n'
+           'b = np.float64(2)  # tiplint: disable=f64-on-tpu\n')
+    assert main([root, "--select", "f64-on-tpu", "--format", "sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tiplint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "f64-on-tpu" in rule_ids
+    assert "unused-suppression" in rule_ids  # synthetic kinds declared too
+    levels = {}
+    for res in run["results"]:
+        levels[res["level"]] = res
+        assert res["ruleId"] == "f64-on-tpu"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "ops/bad.py"
+        assert loc["region"]["startLine"] >= 1
+    assert set(levels) == {"error", "note"}
+    assert levels["note"]["suppressions"] == [{"kind": "inSource"}]
+    assert "suppressions" not in levels["error"]
+
+
+def test_sarif_is_deterministic(tmp_path, capsys):
+    root = str(tmp_path / "pkg")
+    _write(root, "ops/bad.py", '"""m."""\nimport numpy as np\na = np.float64(1)\n')
+    main([root, "--select", "f64-on-tpu", "--format", "sarif"])
+    a = capsys.readouterr().out
+    main([root, "--select", "f64-on-tpu", "--format", "sarif"])
+    b = capsys.readouterr().out
+    assert a == b
