@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Regenerate the README rule catalogue from the tiplint rule registry.
+
+The table between the ``<!-- rule-catalogue:start -->`` and
+``<!-- rule-catalogue:end -->`` markers in README.md is generated from each
+rule's ``name``/``tags``/``description``/``rationale`` metadata — the same
+metadata ``tiplint --list-rules`` prints — so the catalogue cannot drift
+from the shipped rules.
+
+Usage:
+
+    python scripts/gen_rule_docs.py            # rewrite README.md in place
+    python scripts/gen_rule_docs.py --check    # exit 1 if README is stale
+
+CI runs ``--check``; a failing check means "run the generator and commit".
+"""
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+README = os.path.join(REPO_ROOT, "README.md")
+START = "<!-- rule-catalogue:start -->"
+END = "<!-- rule-catalogue:end -->"
+
+
+def _cell(text: str) -> str:
+    """One markdown table cell: collapse whitespace, escape pipes."""
+    return " ".join(text.split()).replace("|", "\\|")
+
+
+def render_table() -> str:
+    """The generated catalogue block (markers excluded)."""
+    sys.path.insert(0, REPO_ROOT)
+    from simple_tip_tpu.analysis.core import all_rules
+
+    lines = [
+        "| Rule | Tags | Catches | Why |",
+        "|---|---|---|---|",
+    ]
+    for name, rule in sorted(all_rules().items()):
+        tags = ", ".join(rule.tags)
+        why = rule.rationale or rule.description
+        lines.append(
+            f"| `{name}` | {_cell(tags)} | {_cell(rule.description)} "
+            f"| {_cell(why)} |"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--check", action="store_true",
+        help="verify README.md is up to date instead of rewriting it",
+    )
+    args = parser.parse_args(argv)
+
+    with open(README, encoding="utf-8") as fh:
+        readme = fh.read()
+    try:
+        head, rest = readme.split(START, 1)
+        _stale, tail = rest.split(END, 1)
+    except ValueError:
+        print(
+            f"gen_rule_docs: README.md is missing the {START} / {END} "
+            "markers", file=sys.stderr,
+        )
+        return 2
+
+    fresh = head + START + "\n" + render_table() + END + tail
+    if args.check:
+        if fresh != readme:
+            print(
+                "gen_rule_docs: README rule catalogue is stale; run "
+                "`python scripts/gen_rule_docs.py` and commit the result",
+                file=sys.stderr,
+            )
+            return 1
+        print("gen_rule_docs: README rule catalogue is up to date")
+        return 0
+    if fresh != readme:
+        with open(README, "w", encoding="utf-8") as fh:
+            fh.write(fresh)
+        print("gen_rule_docs: README.md rewritten")
+    else:
+        print("gen_rule_docs: README.md already up to date")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -79,7 +79,7 @@ def apply_baseline(
             if budget.get(fp, 0) > 0:
                 budget[fp] -= 1
                 covered += 1
-                out.append(replace(f, suppressed=True))
+                out.append(replace(f, suppressed=True, baselined=True))
                 continue
         out.append(f)
     return out, covered

@@ -152,7 +152,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.list_rules:
         for name, rule in sorted(all_rules().items()):
-            print(f"{name}: {rule.description}")
+            tags = f" [{', '.join(rule.tags)}]" if rule.tags else ""
+            print(f"{name}{tags}: {rule.description}")
         return 0
 
     paths = args.paths or [_default_target()]

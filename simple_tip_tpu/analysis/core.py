@@ -40,6 +40,9 @@ class Finding:
     line: int
     message: str
     suppressed: bool = False
+    # True when suppressed by a baseline fingerprint rather than an in-source
+    # comment — reporters distinguish the two (SARIF: external vs inSource).
+    baselined: bool = False
 
     def format(self) -> str:
         """Render as the canonical ``path:line: [rule] message`` text line."""
@@ -137,6 +140,12 @@ class Rule:
 
     name: str = ""
     description: str = ""
+    #: short classification labels (``("sharding", "semantic")``) surfaced by
+    #: ``--list-rules`` and the generated README catalogue
+    tags: Tuple[str, ...] = ()
+    #: one-paragraph "why this matters" text for the README catalogue; falls
+    #: back to ``description`` when empty
+    rationale: str = ""
 
     def check_module(
         self, module: ModuleInfo

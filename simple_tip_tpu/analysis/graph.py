@@ -318,6 +318,10 @@ class ProjectGraph:
         """The module's locally jit-reachable function nodes (cached)."""
         return self._by_module[id(module)].jit_local
 
+    def aliases(self, module: ModuleInfo) -> Dict[str, str]:
+        """The module's import-alias table (``jnp`` -> ``jax.numpy``)."""
+        return self._by_module[id(module)].aliases
+
     def resolve_string(
         self, module: ModuleInfo, node: ast.AST, _depth: int = 0
     ) -> Optional[str]:

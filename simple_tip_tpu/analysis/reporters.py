@@ -92,9 +92,13 @@ def sarif_report(findings: Iterable[Finding]) -> str:
     """SARIF 2.1.0 document (GitHub code scanning ingests this via
     ``codeql-action/upload-sarif``, so findings land in the Security tab
     and annotate PRs natively). Suppressed findings are carried with a
-    ``suppressions`` entry (kind ``inSource``) instead of being dropped —
-    the same debt-stays-visible contract as every other reporter. Output
-    is deterministic for fixed input (sorted keys, no timestamps)."""
+    ``suppressions`` entry instead of being dropped — the same
+    debt-stays-visible contract as every other reporter. In-source
+    ``# tiplint: disable`` comments map to kind ``inSource``;
+    baseline-accepted findings map to kind ``external`` with a
+    justification, so code scanning shows them as suppressed rather than
+    vanished. Output is deterministic for fixed input (sorted keys, no
+    timestamps)."""
     from simple_tip_tpu.analysis.core import all_rules
 
     findings = list(findings)
@@ -132,7 +136,18 @@ def sarif_report(findings: Iterable[Finding]) -> str:
             ],
         }
         if f.suppressed:
-            result["suppressions"] = [{"kind": "inSource"}]
+            if f.baselined:
+                result["suppressions"] = [
+                    {
+                        "kind": "external",
+                        "justification": (
+                            "accepted in tiplint_baseline.json (pre-"
+                            "existing debt; new occurrences still fail)"
+                        ),
+                    }
+                ]
+            else:
+                result["suppressions"] = [{"kind": "inSource"}]
         results.append(result)
     doc = {
         "$schema": (

@@ -12,19 +12,23 @@ from simple_tip_tpu.analysis.rules import (  # noqa: F401
     blocking_endpoint,
     buffer_donation,
     docstring_coverage,
+    dtype_promotion,
     escaping_tracer,
     f64_on_tpu,
     hardcoded_knob,
     host_sync,
     implicit_transfer,
+    indivisible_sharding,
     jit_purity,
     knob_contract,
     naked_retry,
     prng_hygiene,
     retrace_risk,
+    shape_mismatch,
     shape_poly,
     sharding_spec,
     transitive_purity,
+    vmap_axis_clash,
     unfenced_claim,
     unsafe_bus_write,
     unversioned_schema,

@@ -227,6 +227,12 @@ class ArtifactContractRule(Rule):
         "plotters//utils/ (and vice versa), with compatible filename "
         "templates (suffix + field arity)"
     )
+    tags = ('bus', 'contract', 'cross-file')
+    rationale = (
+        "The filesystem bus filename templates are parsed by "
+        "underscore-splitting; a writer and reader drifting apart makes aggregation "
+        "silently read nothing."
+    )
 
     def check_package(
         self, modules: Sequence[ModuleInfo]

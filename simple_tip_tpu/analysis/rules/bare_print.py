@@ -52,6 +52,12 @@ class BarePrintRule(Rule):
         "JSON-line protocols; use the module logger or obs events "
         "(scripts/tests/cli entry points exempt)"
     )
+    tags = ('hygiene', 'logging')
+    rationale = (
+        "stdout in spawned scheduler/pool workers is a pipe nobody reads — or "
+        "one a JSON-line protocol owns; route output through the module logger "
+        "or obs events."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Flag bare print calls outside the exempt surfaces."""

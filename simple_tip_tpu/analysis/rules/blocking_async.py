@@ -57,6 +57,12 @@ class BlockingInAsyncRule(Rule):
         "async def stalls the whole event loop; await the async form or "
         "run it via loop.run_in_executor (scripts/tests exempt)"
     )
+    tags = ('async', 'perf')
+    rationale = (
+        "One blocking call in the serving engine's event loop stalls every "
+        "tenant's request plane: batch assembly stops, flush deadlines blow, "
+        "p99 spikes with no counter saying why."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Flag blocking calls lexically inside async function bodies."""

@@ -122,6 +122,11 @@ class BlockingEndpointRule(Rule):
         "the slow work onto the owning loop's cadence and push it in via "
         "exporter.set_health/set_provider (scripts/tests exempt)"
     )
+    tags = ('async', 'serving', 'perf')
+    rationale = (
+        "An HTTP handler doing filesystem walks or subprocess calls blocks the "
+        "telemetry plane; endpoints must serve pushed in-memory state only."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Flag blocking calls lexically inside handler method bodies."""

@@ -50,6 +50,11 @@ class BufferDonationRule(Rule):
         "jitted state-threading steps (params/opt_state style) without "
         "donate_argnums: old and new state both stay alive, doubling peak HBM"
     )
+    tags = ('memory', 'perf')
+    rationale = (
+        "Old and new state both stay alive across an undonated step: peak HBM "
+        "doubles on multi-GB stacked ensembles."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Flag state-threading jits that do not donate their state args."""

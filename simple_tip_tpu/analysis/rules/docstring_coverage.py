@@ -56,6 +56,11 @@ class DocstringCoverageRule(Rule):
         "classes/functions must be documented (the reference's "
         "docstr-coverage gate)"
     )
+    tags = ('docs', 'hygiene')
+    rationale = (
+        "The reference's docstr-coverage gate, folded into the one "
+        "static-analysis entry point."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Require a module docstring (empty namespace inits exempt)."""

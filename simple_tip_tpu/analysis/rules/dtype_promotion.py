@@ -1,0 +1,53 @@
+"""Rule ``dtype-promotion``: unintended float64 widening in traced code.
+
+The semantic sibling of the syntactic ``f64-on-tpu`` rule: instead of
+pattern-matching ``np.float64`` literals, the tipcheck interpreter
+(``analysis.shapes``) tracks dtypes through the jnp promotion lattice and
+flags the *result* of a promotion landing in f64 inside traced code — the
+``jnp.f32_array * np.linspace(...)`` case, where no f64 literal appears
+anywhere but numpy's float64 default wins the promotion.
+
+Scope is deliberately narrow to stay false-positive-free:
+
+- only **rank >= 1** float64 results count (rank-0 scalars are weakly
+  typed in JAX's x64-disabled default and canonicalize harmlessly),
+- only when the operands were **not already all f64** (an all-f64
+  pipeline is a deliberate choice, and ``f64-on-tpu`` covers the source),
+- only inside **traced frames** (jit/vmap/shard_map bodies and their
+  callees) — host-side f64 bookkeeping is fine,
+- python scalar constants are weak types and never promote arrays.
+
+TPUs have no f64 units; depending on x64 flags the result is either a
+silent downcast (wrong precision expectations) or a slow emulation path.
+"""
+
+from typing import Iterator, Sequence, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+
+
+@register
+class DtypePromotionRule(Rule):
+    """Flag inferred f64 promotions inside traced code."""
+
+    name = "dtype-promotion"
+    description = (
+        "an operation inside traced code promotes mixed operands to a "
+        "float64 array (TPUs have no f64 units)"
+    )
+    tags = ("tipcheck", "dtype", "semantic", "tpu")
+    rationale = (
+        "f64 rarely enters a TPU program through a literal; it enters "
+        "through numpy defaults winning a promotion. Tracking dtypes "
+        "through the promotion lattice catches the widening at the "
+        "operation that commits it, not the symbol that seeded it."
+    )
+
+    def check_package(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Tuple[str, int, str]]:
+        from simple_tip_tpu.analysis.shapes import project_shapes
+
+        for f in project_shapes(modules).findings:
+            if f.kind == self.name:
+                yield f.module.path, f.line, f.message

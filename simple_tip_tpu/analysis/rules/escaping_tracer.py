@@ -55,6 +55,12 @@ class EscapingTracerRule(Rule):
         "trace (UnexpectedTracerError, or a silently stale value baked "
         "in at trace time)"
     )
+    tags = ('traced', 'interprocedural', 'correctness')
+    rationale = (
+        "An escaped tracer outlives its trace: the next use raises "
+        "UnexpectedTracerError at best — at worst it silently bakes one trace's "
+        "constant into every later call."
+    )
 
     def check_package(
         self, modules: Sequence[ModuleInfo]

@@ -45,6 +45,11 @@ class F64OnTpuRule(Rule):
         "float64 dtype usage in device-adjacent modules (TPUs have no "
         "native f64; requests silently downcast) outside the allowlist"
     )
+    tags = ('dtype', 'tpu')
+    rationale = (
+        "TPUs have no native f64 — requests silently downcast to f32, or run an "
+        "order of magnitude slower under x64 emulation."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Flag float64 dtype requests in device-adjacent modules."""

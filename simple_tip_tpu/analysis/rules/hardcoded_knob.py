@@ -86,6 +86,12 @@ class HardcodedKnobRule(Rule):
         "registry (an ExecutionPlan or the operator's shell), not a "
         "code-level pin (scripts/tests exempt)"
     )
+    tags = ('knobs', 'planner')
+    rationale = (
+        "A code-level pin silently overrides any active ExecutionPlan and is "
+        "invisible to plan explain and the plan-vs-actual audit; knob values "
+        "must come from the plan or the operator's shell."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Flag literal knob-env writes outside the exempt surfaces."""

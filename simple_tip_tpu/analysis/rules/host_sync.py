@@ -46,6 +46,11 @@ class HostSyncRule(Rule):
         "np.asarray/np.array on freshly-built jax values and branches on "
         "traced values in hot-path modules (ops/, parallel/, engine/)"
     )
+    tags = ('perf', 'transfer')
+    rationale = (
+        "Each implicit device->host transfer blocks until the device queue "
+        "drains; lethal inside per-badge loops."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Flag implicit syncs and traced-value branches in hot paths."""

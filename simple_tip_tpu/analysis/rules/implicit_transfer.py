@@ -49,6 +49,12 @@ class ImplicitTransferRule(Rule):
         "locally-jitted call in engine/ scoring paths (the dataflow "
         "complement of host-sync's expression-local check)"
     )
+    tags = ('perf', 'transfer', 'dataflow')
+    rationale = (
+        "The name-assignment variant of host-sync: the jnp value crosses a "
+        "local binding before np.asarray, so only flow-sensitive tracking sees "
+        "the transfer."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Track one level of device-value dataflow per scope and flag

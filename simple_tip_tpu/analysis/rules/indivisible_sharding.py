@@ -1,0 +1,55 @@
+"""Rule ``indivisible-sharding``: sharded dims must divide by axis size.
+
+The semantic upgrade of ``sharding-spec-mismatch``: that rule checks that a
+``PartitionSpec`` names real mesh axes; this one checks that the *numbers
+work out*. The tipcheck interpreter (``analysis.shapes``) tracks concrete
+mesh axis sizes (``Mesh(np.asarray(jax.devices()).reshape(2, 2), ...)``
+gives ``dp=2, sp=2``) alongside inferred array shapes, and verifies every
+place a spec meets an array:
+
+- ``jax.device_put(x, NamedSharding(mesh, spec))``,
+- ``shard_map`` ``in_specs`` (dims are divided on entry; the quotient
+  propagates through the body and is multiplied back by ``out_specs``),
+- ``with_sharding_constraint`` and pjit ``in_shardings``,
+- ``all_to_all(tiled=True)`` splitting a dim across the axis.
+
+A dim 100 sharded over an 8-way axis fails at dispatch on the real slice
+with an unhelpful XLA error — or silently pads, skewing throughput numbers.
+
+Conservatism: axis sizes resolved from ``jax.device_count()``, env vars, or
+any expression the interpreter cannot pin degrade to ``Dyn``, and ``Dyn``
+never divides anything — no findings, no false positives on host-portable
+mesh construction.
+"""
+
+from typing import Iterator, Sequence, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+
+
+@register
+class IndivisibleShardingRule(Rule):
+    """Check inferred dims divide by the mesh axis sizes sharding them."""
+
+    name = "indivisible-sharding"
+    description = (
+        "a PartitionSpec'd dim is not divisible by its mesh axis size "
+        "for a mesh constructed in the project"
+    )
+    tags = ("tipcheck", "sharding", "semantic", "interprocedural")
+    rationale = (
+        "Axis-name checks pass while the arithmetic is wrong: a 100-long "
+        "sequence over an 8-way axis dispatches nothing useful at v4-32 "
+        "scale. The interpreter multiplies mesh sizes out of device-array "
+        "literals and checks divisibility at every spec/array meeting "
+        "point, degrading to Dyn (silent) when sizes come from runtime."
+    )
+
+    def check_package(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Tuple[str, int, str]]:
+        from simple_tip_tpu.analysis.shapes import project_shapes
+
+        for f in project_shapes(modules).findings:
+            if f.kind == self.name:
+                yield f.module.path, f.line, f.message

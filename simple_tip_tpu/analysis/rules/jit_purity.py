@@ -68,6 +68,11 @@ class JitPurityRule(Rule):
         ".item()/float()/int()/bool() concretization and jax.debug leftovers "
         "inside jit/vmap/scan-traced functions"
     )
+    tags = ('traced', 'correctness')
+    rationale = (
+        "Side effects run once at trace time (wrong schedule, gone on cache "
+        "hits); concretization stalls the XLA pipeline mid-program."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Run the impurity checks over every jit-reachable function."""

@@ -121,6 +121,12 @@ class KnobContractRule(Rule):
         "(interprocedural: helper reads count at the literal call site; "
         "scripts/tests exempt)"
     )
+    tags = ('knobs', 'planner', 'interprocedural')
+    rationale = (
+        "An undeclared knob is invisible to plan explain, the self-tuning "
+        "search, and the plan-vs-actual audit: the planner cannot reason about "
+        "a dial it doesn't know exists."
+    )
 
     def check_package(
         self, modules: Sequence[ModuleInfo]

@@ -101,6 +101,12 @@ class NakedRetryRule(Rule):
         "time.monotonic deadline or route it through resilience/retry.py "
         "(scripts/tests exempt)"
     )
+    tags = ('resilience',)
+    rationale = (
+        "On this deployment dependencies wedge rather than error, so an "
+        "unbounded poll loop is a hang; bound it or route it through "
+        "resilience/retry.py."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Flag while-loops sleeping with neither deadline nor backoff."""

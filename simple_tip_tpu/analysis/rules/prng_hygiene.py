@@ -61,6 +61,11 @@ class PrngHygieneRule(Rule):
         "a PRNG key passed to two jax.random consumers without an "
         "intervening split/fold_in re-derivation"
     )
+    tags = ('prng', 'statistics')
+    rationale = (
+        "Identical draws: dropout masks repeat, ensemble members correlate — "
+        "silent statistical corruption."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Flag PRNG keys consumed more than once without split/fold_in."""

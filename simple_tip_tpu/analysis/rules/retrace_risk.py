@@ -89,6 +89,12 @@ class RetraceRiskRule(Rule):
         "every iteration/call retraces — hoist the construction (module "
         "level, __init__, or an lru_cached factory)"
     )
+    tags = ('perf', 'traced')
+    rationale = (
+        "A fresh jitted callable per iteration has an empty compile cache, so "
+        "every iteration retraces — the per-badge retrace the program cache "
+        "exists to prevent."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Walk call expressions; flag jit constructions whose compile

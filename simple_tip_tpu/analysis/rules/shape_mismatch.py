@@ -1,0 +1,53 @@
+"""Rule ``shape-mismatch``: statically incompatible array shapes.
+
+The tipcheck abstract interpreter (``analysis.shapes``) propagates symbolic
+``(dims, dtype, spec)`` values through the project graph — from declared
+entry contracts, jit/pjit/vmap/shard_map boundaries, and module top-level
+code — and evaluates the jnp vocabulary's transfer functions on the way.
+This rule surfaces the interpreter's shape contradictions:
+
+- ``reshape`` targets that change the element count,
+- ``matmul``/``@``/``einsum`` contracting or index-binding conflicts,
+- ``concatenate``/``stack`` operands disagreeing off the join axis,
+- broadcasting two dims that are both known, unequal, and neither 1,
+- ``fori_loop``/``while_loop``/``scan`` carries that change shape or
+  structure between iterations.
+
+Every finding carries an ``inferred:`` provenance chain (like the dataflow
+taint chains) showing how the offending shape was derived, hop by hop.
+
+Conservatism: any dim the interpreter cannot pin becomes ``Dyn`` and every
+check involving a ``Dyn`` stays silent, so meshes sized from
+``jax.device_count()`` or env vars can never create false positives.
+"""
+
+from typing import Iterator, Sequence, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+
+
+@register
+class ShapeMismatchRule(Rule):
+    """Surface shape contradictions found by the abstract interpreter."""
+
+    name = "shape-mismatch"
+    description = (
+        "statically incompatible shapes (reshape/matmul/einsum/concat/"
+        "broadcast/loop-carry) under the inferred symbolic shapes"
+    )
+    tags = ("tipcheck", "shapes", "semantic", "interprocedural")
+    rationale = (
+        "A wrong reshape or einsum inside jit fails only when the traced "
+        "path executes — on the pod slice, not the dev box. Abstract "
+        "interpretation over the project graph catches the contradiction "
+        "at lint time, with the inference chain attached."
+    )
+
+    def check_package(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Tuple[str, int, str]]:
+        from simple_tip_tpu.analysis.shapes import project_shapes
+
+        for f in project_shapes(modules).findings:
+            if f.kind == self.name:
+                yield f.module.path, f.line, f.message

@@ -100,6 +100,11 @@ class ShapePolymorphismRule(Rule):
         "functions — the concrete-shape assumptions that break under "
         "jax.export / dynamic batch sizes"
     )
+    tags = ('shapes', 'traced')
+    rationale = (
+        "Concrete-shape escapes break under jax.export symbolic dims and "
+        "dynamic batch sizes, and unroll or re-trace per shape."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Flag concrete-shape escapes in the module's traced functions."""

@@ -41,6 +41,12 @@ class ShardingSpecMismatchRule(Rule):
         "constructed in the analyzed project (cross-module, via the "
         "project graph)"
     )
+    tags = ('sharding', 'cross-file')
+    rationale = (
+        "A typo'd axis fails at dispatch on the real pod slice — or silently "
+        "means 'replicated', running at 1/N parallelism; invisible on a "
+        "single-device dev box."
+    )
 
     def check_package(
         self, modules: Sequence[ModuleInfo]

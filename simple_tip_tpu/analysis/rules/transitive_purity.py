@@ -55,6 +55,11 @@ class TransitiveJitPurityRule(Rule):
         "reached from traced code through cross-module call chains, "
         "flagged at the call site with the chain printed"
     )
+    tags = ('traced', 'interprocedural')
+    rationale = (
+        "The helper's own module looks like innocent host code — only "
+        "whole-program reasoning sees it execute under trace."
+    )
 
     def check_package(
         self, modules: Sequence[ModuleInfo]

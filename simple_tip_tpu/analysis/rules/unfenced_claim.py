@@ -118,6 +118,11 @@ class UnfencedClaimRule(Rule):
         "expiry/fencing in the claiming scope (resilience/, scripts/, "
         "tests exempt)"
     )
+    tags = ('resilience', 'concurrency')
+    rationale = (
+        "A crashed winner never releases an unexpiring claim, and a wedged "
+        "stale holder can still commit; leases need TTL plus a fencing epoch."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Flag lifecycle-blind claim calls outside the exempt surfaces."""

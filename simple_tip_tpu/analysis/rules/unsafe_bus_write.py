@@ -71,6 +71,12 @@ class UnsafeBusWriteRule(Rule):
         "tmp + os.replace idiom: concurrent readers see a half-written "
         "file and racing writers collide (scripts/tests exempt)"
     )
+    tags = ('bus', 'concurrency', 'dataflow')
+    rationale = (
+        "Two fleet workers racing a plain truncating open interleave torn "
+        "halves; readers see half-written JSON mid-publish — atomic replace (or "
+        "append) is the only safe publish."
+    )
 
     def check_package(
         self, modules: Sequence[ModuleInfo]

@@ -144,6 +144,11 @@ class UnversionedSchemaRule(Rule):
         "'schema' version field; appended rows outlive the writer, so "
         "unversioned rows make every format change corrupt the corpus"
     )
+    tags = ('bus', 'contract')
+    rationale = (
+        "Appended rows outlive the writer; unversioned rows make every format "
+        "change corrupt the whole corpus."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Flag JSONL write sites in obs modules lacking a schema stamp."""

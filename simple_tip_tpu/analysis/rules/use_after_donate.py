@@ -107,6 +107,12 @@ class UseAfterDonateRule(Rule):
         "rebind the result or pass a copy (flow-sensitive; dynamic "
         "donate_argnums are never flagged)"
     )
+    tags = ('memory', 'correctness', 'dataflow')
+    rationale = (
+        "Donation hands the buffer to XLA for reuse; a post-call read returns "
+        "whatever the next dispatch scribbled there — garbage gradients with no "
+        "exception on TPU."
+    )
 
     def check_package(
         self, modules: Sequence[ModuleInfo]

@@ -1,0 +1,49 @@
+"""Rule ``vmap-axis-clash``: in_axes/out_axes inconsistent with ranks.
+
+``jax.vmap`` axis bugs are rank bugs: an ``in_axes`` entry pointing past an
+argument's rank, an ``in_axes`` tuple whose length disagrees with the call
+arity, or two mapped arguments whose mapped-axis sizes differ. At runtime
+these fail at trace time *if* the call site executes under test — vmapped
+ensemble steps behind a flag often don't. The tipcheck interpreter
+(``analysis.shapes``) knows the abstract rank and dims of every argument at
+the ``vmap(...)(...)`` application, so all three inconsistencies are
+checkable statically:
+
+- ``in_axes`` tuple length != number of positional arguments,
+- an integer axis outside ``[-rank, rank)`` for its argument,
+- mapped-axis sizes that are both known and unequal.
+
+Conservatism: arguments with unknown rank, non-literal ``in_axes``, and
+``None`` (broadcast) entries are all skipped; ``Dyn`` sizes never clash.
+"""
+
+from typing import Iterator, Sequence, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo, Rule, register
+
+
+@register
+class VmapAxisClashRule(Rule):
+    """Check vmap/pmap axis specifications against inferred ranks."""
+
+    name = "vmap-axis-clash"
+    description = (
+        "vmap/pmap in_axes or out_axes inconsistent with the inferred "
+        "rank or mapped-axis sizes of the arguments"
+    )
+    tags = ("tipcheck", "shapes", "vmap", "semantic")
+    rationale = (
+        "vmap axis errors surface only when the mapped call actually "
+        "executes; the G-group ensemble paths are exactly the kind of "
+        "conditionally-executed code where they hide. Checking in_axes "
+        "against abstract ranks catches them without running anything."
+    )
+
+    def check_package(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Tuple[str, int, str]]:
+        from simple_tip_tpu.analysis.shapes import project_shapes
+
+        for f in project_shapes(modules).findings:
+            if f.kind == self.name:
+                yield f.module.path, f.line, f.message

@@ -64,6 +64,11 @@ class WallclockDurationRule(Rule):
         "time.perf_counter() for durations / time.monotonic() for "
         "deadlines (scripts/tests exempt)"
     )
+    tags = ('hygiene', 'perf')
+    rationale = (
+        "Wall clock is not monotonic: an NTP step mid-measurement corrupts the "
+        "duration silently; benchmarks built on it lie."
+    )
 
     def check_module(self, module: ModuleInfo) -> Iterator[Tuple[str, int, str]]:
         """Flag Sub expressions with a ``time.time()`` operand."""

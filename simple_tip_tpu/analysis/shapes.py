@@ -1,0 +1,3375 @@
+"""tipcheck: abstract interpretation of shapes, dtypes and sharding.
+
+The project-graph rules (PR 2) see *names* — a PartitionSpec axis that no
+mesh declares — and the dataflow rules (PR 16) see *facts* — a donated
+buffer read after donation. Neither can answer the questions that actually
+sink a sharded program on a real pod slice: does this dim **divide** by the
+mesh axis it is sharded over, is this reshape element-count-preserving
+under the shapes that reach it, does dtype promotion silently widen to f64
+inside traced code? This module answers them with a conservative abstract
+interpreter over the same stdlib-``ast`` trees:
+
+- an abstract array is ``Arr(dims, dtype, spec, chain)`` where each dim is
+  a concrete ``int``, an interned symbol (``Sym('B')`` — from the declared
+  contract table), or ``DYN`` (statically unknown); ``chain`` is the
+  provenance trail rendered into findings like the dataflow taint chains;
+- transfer functions cover the jnp/np/lax/nn vocabulary the package uses
+  (matmul/einsum, reshape/transpose/concat/stack/pad, reductions,
+  broadcasting + dtype promotion, conv/pool for the MNIST/CIFAR kernels)
+  plus the transform boundaries: ``vmap`` prepends the mapped dim,
+  ``shard_map`` divides spec'd dims by the mesh axis size, ``jit``
+  in_shardings attach and are divisibility-checked;
+- whole-program entry points are (a) every module's top-level statement
+  list, (b) every traced function the project graph discovers, (c) the
+  declared-contract table below (entry shapes seeded from the CaseStudy
+  registry — badge size 128, 10 classes — and the attention helpers'
+  documented ``[B, T, H, D]`` layout), interpreted interprocedurally
+  through resolvable project calls.
+
+Everything degrades to ``DYN``/``UNKNOWN`` rather than guessing: a mesh
+built from ``jax.devices()`` or ``jax.device_count()`` has ``DYN`` axis
+sizes and can never produce a divisibility finding; an unresolvable call
+returns ``UNKNOWN`` and downstream checks go silent. Findings are deduped
+per (kind, module, line) and fully deterministic, so ``--cache`` replay
+stays byte-identical.
+
+Like every analysis module this is pure stdlib — no jax import, ever.
+"""
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from simple_tip_tpu.analysis.core import ModuleInfo
+from simple_tip_tpu.analysis.graph import (
+    MESH_CALLEES,
+    PARTITION_SPEC_CALLEES,
+    FunctionInfo,
+    project_graph,
+)
+from simple_tip_tpu.analysis.rules.common import callee_name, dotted
+
+# --------------------------------------------------------------------------
+# value model
+# --------------------------------------------------------------------------
+
+
+class _DynType:
+    """Statically-unknown dimension (prints as ``?``)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "?"
+
+
+DYN = _DynType()
+
+
+class Sym:
+    """An interned symbolic dimension (``Sym('B')`` from a contract)."""
+
+    _interned: Dict[str, "Sym"] = {}
+    __slots__ = ("name",)
+
+    def __new__(cls, name: str):
+        sym = cls._interned.get(name)
+        if sym is None:
+            sym = super().__new__(cls)
+            sym.name = name
+            cls._interned[name] = sym
+        return sym
+
+    def __repr__(self):
+        return self.name
+
+
+class _UnknownType:
+    """Top of the value lattice: no information."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<unknown>"
+
+
+UNKNOWN = _UnknownType()
+
+#: provenance chain entry list: ((line, description), ...), capped at 6
+Chain = Tuple[Tuple[int, str], ...]
+
+
+@dataclass(eq=False)
+class Arr:
+    """Abstract array: dims (None = unknown rank), dtype, sharding spec."""
+
+    dims: Optional[Tuple[object, ...]]
+    dtype: Optional[str] = None
+    spec: Optional[Tuple[object, ...]] = None  # PartitionSpec entries
+    chain: Chain = ()
+
+
+@dataclass(eq=False)
+class Const:
+    """A concrete python value (int, float, str, bool, None, Ellipsis)."""
+
+    value: object
+
+
+@dataclass(eq=False)
+class TupVal:
+    """A tuple/list of abstract values."""
+
+    items: Tuple[object, ...]
+
+
+@dataclass(eq=False)
+class MeshVal:
+    """A device mesh: axis names plus per-axis sizes (int or DYN)."""
+
+    axes: Tuple[str, ...]
+    sizes: Tuple[object, ...]
+
+
+@dataclass(eq=False)
+class MeshShapeVal:
+    """``mesh.shape`` — an axis-name -> size mapping view."""
+
+    mesh: MeshVal
+
+
+@dataclass(eq=False)
+class SpecVal:
+    """A PartitionSpec: positional entries (str axis | tuple | None | DYN)."""
+
+    entries: Tuple[object, ...]
+
+
+@dataclass(eq=False)
+class ShardingVal:
+    """A NamedSharding: mesh + spec (either side may be unknown)."""
+
+    mesh: Optional[MeshVal]
+    spec: Optional[SpecVal]
+
+
+@dataclass(eq=False)
+class DtypeVal:
+    """A dtype object (``jnp.float32``); calling it casts."""
+
+    name: str
+
+
+@dataclass(eq=False)
+class FnVal:
+    """A callable: project function, nested def/lambda, or builtin name.
+
+    ``kw_unknown`` marks a partial application whose keyword bindings did
+    not resolve — unbound parameters become UNKNOWN instead of taking
+    their defaults (the conservative reading of ``partial(f, **kw)``).
+    """
+
+    module: Optional[ModuleInfo] = None
+    node: Optional[ast.AST] = None  # FunctionDef/Lambda for project code
+    closure: Optional[dict] = None  # enclosing env for nested defs/lambdas
+    builtin: Optional[str] = None  # canonical dotted name otherwise
+    bound_args: Tuple = ()
+    bound_kwargs: Optional[dict] = None
+    kw_unknown: bool = False
+
+
+@dataclass(eq=False)
+class XformVal:
+    """A transform-wrapped callable (jit/vmap/pmap/grad/shard_map/...)."""
+
+    kind: str
+    fn: object
+    meta: dict
+
+
+@dataclass(eq=False)
+class LayerVal:
+    """A constructed flax layer (Conv/Dense/pool config), callable."""
+
+    kind: str
+    meta: dict
+
+
+@dataclass(eq=False)
+class MethodVal:
+    """A bound method reference (``x.reshape``), dispatched at call."""
+
+    obj: object
+    attr: str
+
+
+@dataclass(eq=False)
+class AtIdxVal:
+    """``x.at[idx]`` — ``.set``/``.add``/... return the base array."""
+
+    arr: Arr
+
+
+@dataclass(eq=False)
+class ModRef:
+    """A dotted module/prefix reference (``jax.sharding``)."""
+
+    name: str
+
+
+@dataclass(eq=False)
+class ShapeFinding:
+    """One interpreter finding, consumed by the thin rule wrappers."""
+
+    kind: str  # shape-mismatch | indivisible-sharding | dtype-promotion | vmap-axis-clash
+    module: ModuleInfo
+    line: int
+    message: str
+
+
+class _Budget(Exception):
+    """Raised internally when the per-run interpretation budget runs out."""
+
+
+# --------------------------------------------------------------------------
+# dtypes and formatting
+# --------------------------------------------------------------------------
+
+_DTYPE_NAMES = {
+    "bool", "int8", "uint8", "int16", "uint16", "int32", "uint32",
+    "int64", "uint64", "bfloat16", "float16", "float32", "float64",
+    "complex64", "complex128",
+}
+
+_DTYPE_SHORT = {
+    "bool": "bool", "int8": "i8", "uint8": "u8", "int16": "i16",
+    "uint16": "u16", "int32": "i32", "uint32": "u32", "int64": "i64",
+    "uint64": "u64", "bfloat16": "bf16", "float16": "f16",
+    "float32": "f32", "float64": "f64", "complex64": "c64",
+    "complex128": "c128",
+}
+
+_PROMO_ORDER = {
+    "bool": 0, "int8": 1, "uint8": 1, "int16": 2, "uint16": 2,
+    "int32": 3, "uint32": 3, "int64": 4, "uint64": 4,
+    "bfloat16": 5, "float16": 5, "float32": 6, "float64": 7,
+    "complex64": 8, "complex128": 9,
+}
+
+
+def promote_dtype(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    """JAX-style strong-type promotion; None (unknown) is absorbing."""
+    if a is None or b is None:
+        return None
+    if a == b:
+        return a
+    oa, ob = _PROMO_ORDER.get(a), _PROMO_ORDER.get(b)
+    if oa is None or ob is None:
+        return None
+    if oa == ob:
+        # bfloat16 x float16 promotes to float32 in JAX's lattice
+        return "float32" if oa == 5 else a
+    return a if oa > ob else b
+
+
+def fmt_dims(dims: Optional[Tuple[object, ...]]) -> str:
+    """``[4,128,?,B]`` — ``[...]`` when the rank itself is unknown."""
+    if dims is None:
+        return "[...]"
+    return "[" + ",".join(repr(d) for d in dims) + "]"
+
+
+def fmt_arr(arr: Arr) -> str:
+    """``f32[4,128,8,64]`` (``arr`` when the dtype is unknown)."""
+    short = _DTYPE_SHORT.get(arr.dtype or "", arr.dtype or "arr")
+    return f"{short}{fmt_dims(arr.dims)}"
+
+
+def fmt_spec(entries: Tuple[object, ...]) -> str:
+    """``P(None, 'sp', None)`` — the PartitionSpec literal rendering."""
+    parts = []
+    for e in entries:
+        if e is None:
+            parts.append("None")
+        elif isinstance(e, tuple):
+            parts.append("(" + ", ".join(repr(x) for x in e) + ")")
+        elif e is DYN:
+            parts.append("?")
+        else:
+            parts.append(repr(e))
+    return "P(" + ", ".join(parts) + ")"
+
+
+def extend_chain(chain: Chain, line: int, desc: str) -> Chain:
+    """Append a provenance hop, keeping the source plus the last 5 hops."""
+    new = tuple(chain) + ((line, desc),)
+    if len(new) > 6:
+        new = new[:1] + new[-5:]
+    return new
+
+
+def render_chain(chain: Chain) -> str:
+    """The dataflow-style ``desc (line N) -> ...`` provenance rendering."""
+    return " -> ".join(f"{desc} (line {line})" for line, desc in chain)
+
+
+def _dim_to_val(dim: object) -> object:
+    """A dim as a scalar abstract value (for ``x.shape`` unpacking)."""
+    if isinstance(dim, int):
+        return Const(dim)
+    if isinstance(dim, Sym):
+        return dim
+    return UNKNOWN
+
+
+def _val_to_dim(val: object) -> object:
+    """A scalar abstract value as a dim (for ``reshape(b, -1, 32)``)."""
+    if isinstance(val, Const) and isinstance(val.value, int) and not isinstance(val.value, bool):
+        return val.value
+    if isinstance(val, Sym):
+        return val
+    return DYN
+
+
+def _known_int(val: object) -> Optional[int]:
+    if isinstance(val, Const) and isinstance(val.value, int) and not isinstance(val.value, bool):
+        return val.value
+    return None
+
+
+def _truthiness(val: object) -> Optional[bool]:
+    """Definite truth value, or None when statically unknown."""
+    if isinstance(val, Const):
+        try:
+            return bool(val.value)
+        except Exception:
+            return None
+    if isinstance(val, TupVal):
+        return bool(val.items)
+    return None
+
+
+#: transform-wrapper callees -> interpreter kind
+_XFORM_KINDS = {
+    "jax.jit": "jit",
+    "jax.pjit": "jit",
+    "jax.experimental.pjit.pjit": "jit",
+    "jax.checkpoint": "jit",
+    "jax.remat": "jit",
+    "jax.named_call": "jit",
+    "jax.vmap": "vmap",
+    "jax.pmap": "pmap",
+    "jax.grad": "grad",
+    "jax.value_and_grad": "value_and_grad",
+    "jax.shard_map": "shard_map",
+    "jax.experimental.shard_map.shard_map": "shard_map",
+    "jax.experimental.pallas.pallas_call": "pallas_call",
+}
+
+#: decorators that wrap without changing the callable's abstract behavior
+_PASSTHROUGH_DECORATORS = {
+    "functools.lru_cache", "functools.cache", "functools.wraps",
+    "staticmethod", "classmethod", "property", "typing.overload",
+    "abc.abstractmethod", "nn.compact", "flax.linen.compact",
+}
+
+#: attribute constants (``jnp.inf`` and friends)
+_ATTR_CONSTS = {}
+for _mod in ("jax.numpy", "numpy", "math"):
+    _ATTR_CONSTS[f"{_mod}.inf"] = float("inf")
+    _ATTR_CONSTS[f"{_mod}.nan"] = float("nan")
+    _ATTR_CONSTS[f"{_mod}.pi"] = 3.141592653589793
+    _ATTR_CONSTS[f"{_mod}.e"] = 2.718281828459045
+_ATTR_CONSTS["numpy.newaxis"] = None
+_ATTR_CONSTS["jax.numpy.newaxis"] = None
+
+_NAMED_SHARDING_CALLEES = {
+    "jax.sharding.NamedSharding",
+    "jax.NamedSharding",
+}
+
+#: elementwise unary array functions (shape- and mostly dtype-preserving)
+_UNARY_ELEMENTWISE = {
+    "exp", "exp2", "expm1", "log", "log2", "log10", "log1p", "sqrt",
+    "cbrt", "sin", "cos", "tan", "arcsin", "arccos", "arctan", "sinh",
+    "cosh", "tanh", "arcsinh", "arccosh", "arctanh", "abs", "absolute",
+    "fabs", "negative", "positive", "sign", "floor", "ceil", "rint",
+    "trunc", "square", "reciprocal", "conjugate", "conj", "real", "imag",
+    "nan_to_num", "degrees", "radians", "rad2deg", "deg2rad", "i0",
+    "sinc", "erf",
+}
+
+#: unary functions that always return bool arrays
+_UNARY_BOOL = {"isnan", "isinf", "isfinite", "isneginf", "isposinf",
+               "logical_not", "signbit"}
+
+#: unary float-promoting set (int input becomes the lib's default float)
+_UNARY_FLOATING = {
+    "exp", "exp2", "expm1", "log", "log2", "log10", "log1p", "sqrt",
+    "cbrt", "sin", "cos", "tan", "arcsin", "arccos", "arctan", "sinh",
+    "cosh", "tanh", "arcsinh", "arccosh", "arctanh", "reciprocal",
+    "degrees", "radians", "rad2deg", "deg2rad", "sinc", "erf",
+}
+
+#: binary broadcasting array functions
+_BINARY_BROADCAST = {
+    "add", "subtract", "multiply", "divide", "true_divide",
+    "floor_divide", "mod", "remainder", "fmod", "power", "float_power",
+    "maximum", "minimum", "fmax", "fmin", "hypot", "arctan2",
+    "logaddexp", "logaddexp2", "nextafter", "copysign", "heaviside",
+    "left_shift", "right_shift", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "gcd", "lcm",
+}
+
+#: binary broadcasting comparisons (bool result)
+_BINARY_BOOL = {
+    "equal", "not_equal", "greater", "less", "greater_equal",
+    "less_equal", "logical_and", "logical_or", "logical_xor",
+    "isclose", "array_equal",
+}
+
+#: axis reductions
+_REDUCTIONS = {
+    "sum", "mean", "prod", "max", "min", "amax", "amin", "nanmax",
+    "nanmin", "nansum", "nanmean", "var", "std", "nanvar", "nanstd",
+    "all", "any", "median", "nanmedian", "count_nonzero", "ptp",
+    "argmax", "argmin", "nanargmax", "nanargmin", "logsumexp",
+}
+
+_REDUCTION_INT_RESULT = {"argmax", "argmin", "nanargmax", "nanargmin",
+                         "count_nonzero"}
+_REDUCTION_BOOL_RESULT = {"all", "any"}
+
+#: shape-preserving array transforms
+_SAME_SHAPE = {
+    "sort", "argsort", "flip", "fliplr", "flipud", "roll", "clip",
+    "cumsum", "cumprod", "nancumsum", "nancumprod", "tril", "triu",
+    "round", "around", "copy", "asarray_chkfinite", "ascontiguousarray",
+    "stop_gradient",
+}
+
+#: jax.nn elementwise activations (shape-preserving, float-promoting)
+_NN_UNARY = {
+    "relu", "relu6", "gelu", "silu", "swish", "sigmoid", "tanh",
+    "softplus", "soft_sign", "log_sigmoid", "elu", "leaky_relu", "selu",
+    "celu", "hard_sigmoid", "hard_silu", "hard_swish", "hard_tanh",
+    "softmax", "log_softmax", "standardize", "normalize", "squareplus",
+    "mish", "logsumexp",
+}
+
+
+# --------------------------------------------------------------------------
+# declared entry contracts
+# --------------------------------------------------------------------------
+
+
+def _bthd(dtype: Optional[str] = None) -> Arr:
+    return Arr((Sym("B"), Sym("T"), Sym("H"), Sym("D")), dtype)
+
+
+#: CaseStudy registry constants the contract table is seeded from
+#: (casestudies/mini.py: prediction_badge_size=128, num_classes=10).
+BADGE_SIZE = 128
+NUM_CLASSES = 10
+
+#: dotted function name -> {param name: abstract value}. Entry shapes for
+#: interprocedural verification of whole chains; params not named here
+#: bind UNKNOWN. Layouts come from each function's documented contract.
+CONTRACTS: Dict[str, Dict[str, object]] = {
+    # sequence-parallel attention: per-device [batch, seq, heads, head_dim]
+    "simple_tip_tpu.parallel.ring_attention.ring_attention": {
+        "q": _bthd(), "k": _bthd(), "v": _bthd(),
+    },
+    "simple_tip_tpu.parallel.ring_attention.dense_attention_f32_softmax": {
+        "q": _bthd(), "k": _bthd(), "v": _bthd(),
+    },
+    "simple_tip_tpu.parallel.ring_attention.ring_self_attention_reference": {
+        "q": _bthd(), "k": _bthd(), "v": _bthd(),
+    },
+    "simple_tip_tpu.parallel.ulysses_attention.ulysses_attention": {
+        "q": _bthd(), "k": _bthd(), "v": _bthd(),
+    },
+    # fused chain: badge-sized traced vectors (badge rows x flattened bits)
+    "simple_tip_tpu.ops.fused_chain.pack_bits_u32": {
+        "flat": Arr((BADGE_SIZE, Sym("W")), "bool"),
+    },
+    "simple_tip_tpu.ops.fused_chain.select_top_k": {
+        "values": Arr((Sym("N"),), "float32"),
+        "valid": Arr((), "int32"),
+    },
+    # convnet entries: NHWC badge batches, 10-class head
+    "simple_tip_tpu.models.convnet.MnistConvNet.__call__": {
+        "x": Arr((Sym("B"), 28, 28, 1), "float32"),
+    },
+    "simple_tip_tpu.models.convnet.Cifar10ConvNet.__call__": {
+        "x": Arr((Sym("B"), 32, 32, 3), "float32"),
+    },
+}
+
+
+# --------------------------------------------------------------------------
+# the interpreter
+# --------------------------------------------------------------------------
+
+
+@dataclass(eq=False)
+class _Frame:
+    """One interpretation frame (module scope or function activation)."""
+
+    module: ModuleInfo
+    env: Dict[str, object]
+    traced: bool
+    axis_env: Dict[str, object]  # mesh axis name -> size (int | DYN)
+    depth: int
+    stack: frozenset  # ids of function nodes on the interpretive call stack
+    returns: List[object] = field(default_factory=list)
+
+
+_MAX_DEPTH = 8
+_STEP_BUDGET = 400_000
+
+
+class ProjectShapes:
+    """Whole-program shape/dtype/sharding interpretation of one module set.
+
+    Build once per run via :func:`project_shapes` (identity-cached on the
+    module list like ``project_graph``); the four shape rules are thin
+    filters over :attr:`findings`.
+    """
+
+    def __init__(self, modules: Sequence[ModuleInfo]):
+        self.modules = modules
+        self.graph = project_graph(modules)
+        self.findings: List[ShapeFinding] = []
+        self._seen: Set[Tuple[str, int, int]] = set()
+        self._module_env: Dict[int, Dict[str, object]] = {}
+        self._by_name: Dict[str, ModuleInfo] = {
+            self.graph.module_name(m): m for m in modules
+        }
+        self._steps = _STEP_BUDGET
+        self._debug = bool(os.environ.get("TIPLINT_SHAPES_DEBUG"))
+        self._run()
+
+    # -- driver ------------------------------------------------------------
+
+    def _run(self) -> None:
+        for m in self.modules:
+            self._env_of(m)
+        ran: Set[int] = set()
+        for fi, _boundary in self.graph.traced_entries():
+            ran.add(id(fi))
+            self._run_entry(fi)
+        for name in sorted(CONTRACTS):
+            fi = self.graph.functions.get(name)
+            if fi is not None and id(fi) not in ran:
+                ran.add(id(fi))
+                self._run_entry(fi)
+        # Fallback sweep: every remaining function runs untraced with
+        # UNKNOWN parameters, so locally-constructed shapes (vmap calls,
+        # mesh/device_put sites, concatenations) are still checked even
+        # when nothing jit-reachable calls them.
+        for name in sorted(self.graph.functions):
+            fi = self.graph.functions[name]
+            if id(fi) not in ran:
+                ran.add(id(fi))
+                self._run_entry(fi, traced=False)
+
+    def _guard(self, fn, *args):
+        """Run one entry; interpreter errors never break the analyzer."""
+        try:
+            return fn(*args)
+        except _Budget:
+            return None
+        except RecursionError:
+            return None
+        except Exception:
+            if self._debug:
+                raise
+            return None
+
+    def _env_of(self, module: ModuleInfo) -> Dict[str, object]:
+        """The module's interpreted top-level environment (memoized)."""
+        key = id(module)
+        if key in self._module_env:
+            return self._module_env[key]
+        env: Dict[str, object] = {}
+        self._module_env[key] = env
+        frame = _Frame(module=module, env=env, traced=False, axis_env={},
+                       depth=0, stack=frozenset())
+        self._guard(self._exec_block, frame, module.tree.body)
+        return env
+
+    def _run_entry(self, fi: FunctionInfo, traced: bool = True) -> None:
+        """Interpret one traced/contracted function standalone."""
+        contract = CONTRACTS.get(fi.dotted, {})
+        self._guard(self._entry_body, fi, contract, traced)
+
+    def _entry_body(self, fi: FunctionInfo, contract: Dict[str, object],
+                    traced: bool = True):
+        frame = _Frame(module=fi.module, env=dict(self._env_of(fi.module)),
+                       traced=traced, axis_env={}, depth=0, stack=frozenset())
+        self._call_project(fi.module, fi.node, None, [], dict(contract),
+                           frame, fi.node.lineno, kw_unknown=False,
+                           contract_defaults=True)
+
+    # -- findings ----------------------------------------------------------
+
+    def _emit(self, kind: str, frame: _Frame, line: int, message: str,
+              chain: Chain = ()) -> None:
+        key = (kind, id(frame.module), line)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        if chain:
+            message = f"{message}; inferred: {render_chain(chain)}"
+        self.findings.append(
+            ShapeFinding(kind=kind, module=frame.module, line=line,
+                         message=message)
+        )
+
+    # -- statements --------------------------------------------------------
+
+    def _step(self) -> None:
+        self._steps -= 1
+        if self._steps <= 0:
+            raise _Budget()
+
+    def _exec_block(self, frame: _Frame, stmts: Sequence[ast.stmt]) -> str:
+        """Execute statements; returns 'dead' when control definitely left
+        the block (return/raise/break/continue), else 'live'."""
+        for stmt in stmts:
+            status = self._exec_stmt(frame, stmt)
+            if status == "dead":
+                return "dead"
+        return "live"
+
+    def _exec_stmt(self, frame: _Frame, stmt: ast.stmt) -> str:
+        self._step()
+        try:
+            return self._exec_stmt_inner(frame, stmt)
+        except _Budget:
+            raise
+        except RecursionError:
+            raise
+        except Exception:
+            if self._debug:
+                raise
+            return "live"
+
+    def _exec_stmt_inner(self, frame: _Frame, stmt: ast.stmt) -> str:
+        if isinstance(stmt, ast.Expr):
+            self._eval(frame, stmt.value)
+            return "live"
+        if isinstance(stmt, ast.Assign):
+            val = self._eval(frame, stmt.value)
+            for target in stmt.targets:
+                self._assign(frame, target, val)
+            return "live"
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._assign(frame, stmt.target,
+                             self._eval(frame, stmt.value))
+            return "live"
+        if isinstance(stmt, ast.AugAssign):
+            cur = self._eval(frame, stmt.target)
+            rhs = self._eval(frame, stmt.value)
+            val = self._binop(frame, stmt.op, cur, rhs, stmt.lineno)
+            self._assign(frame, stmt.target, val)
+            return "live"
+        if isinstance(stmt, ast.Return):
+            frame.returns.append(
+                Const(None) if stmt.value is None
+                else self._eval(frame, stmt.value)
+            )
+            return "dead"
+        if isinstance(stmt, ast.If):
+            return self._exec_if(frame, stmt)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return self._exec_for(frame, stmt)
+        if isinstance(stmt, ast.While):
+            return self._exec_while(frame, stmt)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            frame.env[stmt.name] = self._bind_def(frame, stmt)
+            return "live"
+        if isinstance(stmt, ast.Lambda):  # pragma: no cover - not a stmt
+            return "live"
+        if isinstance(stmt, ast.ClassDef):
+            frame.env[stmt.name] = UNKNOWN
+            return "live"
+        if isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self._eval(frame, stmt.exc)
+            return "dead"
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return "dead"
+        if isinstance(stmt, ast.Assert):
+            self._eval(frame, stmt.test)
+            return "live"
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            # Name resolution falls back to the graph's alias table, which
+            # already indexes imports anywhere in the file.
+            return "live"
+        if isinstance(stmt, ast.Try):
+            pre = dict(frame.env)
+            body_status = self._exec_block(frame, stmt.body)
+            envs = [frame.env] if body_status == "live" else []
+            for handler in stmt.handlers:
+                henv = dict(pre)
+                hframe = self._fork(frame, henv)
+                if handler.name:
+                    henv[handler.name] = UNKNOWN
+                if self._exec_block(hframe, handler.body) == "live":
+                    envs.append(henv)
+            if stmt.orelse and body_status == "live":
+                if self._exec_block(frame, stmt.orelse) == "dead":
+                    envs = [e for e in envs if e is not frame.env]
+            frame.env.clear()
+            frame.env.update(self._join_envs(envs) if envs else pre)
+            if stmt.finalbody:
+                self._exec_block(frame, stmt.finalbody)
+            return "live" if envs else "dead"
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                val = self._eval(frame, item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign(frame, item.optional_vars, val)
+            return self._exec_block(frame, stmt.body)
+        if isinstance(stmt, ast.Delete):
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    frame.env.pop(target.id, None)
+            return "live"
+        # Global/Nonlocal/Pass/Match and anything newer: no env effect we
+        # can model soundly — weaken every name the statement assigns.
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                frame.env[node.id] = UNKNOWN
+        return "live"
+
+    def _fork(self, frame: _Frame, env: Dict[str, object]) -> _Frame:
+        new = _Frame(module=frame.module, env=env, traced=frame.traced,
+                     axis_env=frame.axis_env, depth=frame.depth,
+                     stack=frame.stack)
+        new.returns = frame.returns  # share the return accumulator
+        return new
+
+    def _exec_if(self, frame: _Frame, stmt: ast.If) -> str:
+        cond = self._eval(frame, stmt.test)
+        truth = _truthiness(cond)
+        if truth is True:
+            return self._exec_block(frame, stmt.body)
+        if truth is False:
+            return self._exec_block(frame, stmt.orelse)
+        then_env = dict(frame.env)
+        else_env = dict(frame.env)
+        then_status = self._exec_block(self._fork(frame, then_env), stmt.body)
+        else_status = self._exec_block(self._fork(frame, else_env), stmt.orelse)
+        live = [env for env, status in ((then_env, then_status),
+                                        (else_env, else_status))
+                if status == "live"]
+        if not live:
+            return "dead"
+        frame.env.clear()
+        frame.env.update(self._join_envs(live))
+        return "live"
+
+    def _exec_for(self, frame: _Frame, stmt) -> str:
+        iterable = self._eval(frame, stmt.iter)
+        pre = dict(frame.env)
+        item: object = UNKNOWN
+        if isinstance(iterable, TupVal) and iterable.items:
+            item = iterable.items[0]
+            for other in iterable.items[1:]:
+                item = self._join(item, other)
+        self._assign(frame, stmt.target, item)
+        self._exec_block(frame, stmt.body)
+        if stmt.orelse:
+            self._exec_block(frame, stmt.orelse)
+        joined = self._join_envs([pre, dict(frame.env)])
+        frame.env.clear()
+        frame.env.update(joined)
+        return "live"
+
+    def _exec_while(self, frame: _Frame, stmt: ast.While) -> str:
+        self._eval(frame, stmt.test)
+        pre = dict(frame.env)
+        self._exec_block(frame, stmt.body)
+        if stmt.orelse:
+            self._exec_block(frame, stmt.orelse)
+        joined = self._join_envs([pre, dict(frame.env)])
+        frame.env.clear()
+        frame.env.update(joined)
+        return "live"
+
+    def _bind_def(self, frame: _Frame, stmt) -> object:
+        """A def statement's bound value: FnVal wrapped by its decorators."""
+        val: object = FnVal(module=frame.module, node=stmt,
+                            closure=frame.env)
+        aliases = self.graph.aliases(frame.module)
+        for deco in reversed(stmt.decorator_list):
+            name = dotted(deco, aliases)
+            if name in _PASSTHROUGH_DECORATORS:
+                continue
+            if name in _XFORM_KINDS:
+                val = XformVal(kind=_XFORM_KINDS[name], fn=val, meta={})
+                continue
+            if isinstance(deco, ast.Call):
+                inner = callee_name(deco, aliases)
+                if inner in _PASSTHROUGH_DECORATORS:
+                    continue
+                if inner in _XFORM_KINDS:
+                    meta = self._eval_kwargs(frame, deco)[0]
+                    val = XformVal(kind=_XFORM_KINDS[inner], fn=val, meta=meta)
+                    continue
+                if inner in ("functools.partial", "partial") and deco.args:
+                    first = dotted(deco.args[0], aliases)
+                    if first in _XFORM_KINDS:
+                        meta = self._eval_kwargs(frame, deco)[0]
+                        val = XformVal(kind=_XFORM_KINDS[first], fn=val,
+                                       meta=meta)
+                        continue
+            return UNKNOWN  # unmodeled decorator: value unknown
+        return val
+
+    def _eval_kwargs(self, frame: _Frame, call: ast.Call):
+        """(kwargs dict, kw_splat flag) for a call's keyword arguments."""
+        kwargs: Dict[str, object] = {}
+        splat = False
+        for kw in call.keywords:
+            if kw.arg is None:
+                splat = True
+                self._eval(frame, kw.value)
+            else:
+                kwargs[kw.arg] = self._eval(frame, kw.value)
+        return kwargs, splat
+
+    def _assign(self, frame: _Frame, target: ast.expr, val: object) -> None:
+        if isinstance(target, ast.Name):
+            frame.env[target.id] = val
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            items: Optional[Tuple[object, ...]] = None
+            if isinstance(val, TupVal):
+                items = val.items
+            elif isinstance(val, Arr) and val.dims is not None and val.dims:
+                lead = val.dims[0]
+                if isinstance(lead, int) and lead == len(target.elts):
+                    items = tuple(
+                        Arr(val.dims[1:], val.dtype) for _ in target.elts
+                    )
+            has_star = any(isinstance(e, ast.Starred) for e in target.elts)
+            if items is not None and not has_star and \
+                    len(items) == len(target.elts):
+                for sub, item in zip(target.elts, items):
+                    self._assign(frame, sub, item)
+                return
+            for sub in target.elts:
+                inner = sub.value if isinstance(sub, ast.Starred) else sub
+                self._assign(frame, inner, UNKNOWN)
+            return
+        # Subscript/Attribute stores: no model (objects are opaque here).
+
+    # -- joins -------------------------------------------------------------
+
+    def _join_envs(self, envs: List[Dict[str, object]]) -> Dict[str, object]:
+        if len(envs) == 1:
+            return envs[0]
+        keys = set()
+        for env in envs:
+            keys.update(env)
+        out: Dict[str, object] = {}
+        for key in keys:
+            if not all(key in env for env in envs):
+                out[key] = UNKNOWN
+                continue
+            val = envs[0][key]
+            for env in envs[1:]:
+                val = self._join(val, env[key])
+            out[key] = val
+        return out
+
+    def _join(self, a: object, b: object) -> object:
+        if a is b:
+            return a
+        if isinstance(a, Arr) and isinstance(b, Arr):
+            dims: Optional[Tuple[object, ...]]
+            if a.dims is None or b.dims is None or len(a.dims) != len(b.dims):
+                dims = None
+            else:
+                dims = tuple(
+                    da if (da is db or (isinstance(da, int) and da == db))
+                    else DYN
+                    for da, db in zip(a.dims, b.dims)
+                )
+            dtype = a.dtype if a.dtype == b.dtype else None
+            spec = a.spec if a.spec == b.spec else None
+            return Arr(dims, dtype, spec, a.chain or b.chain)
+        if isinstance(a, Const) and isinstance(b, Const):
+            try:
+                if type(a.value) is type(b.value) and a.value == b.value:
+                    return a
+            except Exception:
+                pass
+            return UNKNOWN
+        if isinstance(a, TupVal) and isinstance(b, TupVal):
+            if len(a.items) == len(b.items):
+                return TupVal(tuple(
+                    self._join(x, y) for x, y in zip(a.items, b.items)
+                ))
+            return UNKNOWN
+        if isinstance(a, FnVal) and isinstance(b, FnVal):
+            if a.node is b.node and a.builtin == b.builtin:
+                merged = FnVal(
+                    module=a.module, node=a.node, closure=a.closure,
+                    builtin=a.builtin, bound_args=a.bound_args,
+                    bound_kwargs=a.bound_kwargs,
+                    kw_unknown=a.kw_unknown or b.kw_unknown
+                    or a.bound_kwargs != b.bound_kwargs
+                    or len(a.bound_args) != len(b.bound_args),
+                )
+                return merged
+            return UNKNOWN
+        if isinstance(a, MeshVal) and isinstance(b, MeshVal):
+            if a.axes == b.axes and a.sizes == b.sizes:
+                return a
+            return UNKNOWN
+        if isinstance(a, SpecVal) and isinstance(b, SpecVal):
+            if a.entries == b.entries:
+                return a
+            return UNKNOWN
+        if isinstance(a, DtypeVal) and isinstance(b, DtypeVal):
+            return a if a.name == b.name else UNKNOWN
+        return UNKNOWN
+
+    # -- expressions -------------------------------------------------------
+
+    def _eval(self, frame: _Frame, node: ast.expr) -> object:
+        self._step()
+        try:
+            return self._eval_inner(frame, node)
+        except _Budget:
+            raise
+        except RecursionError:
+            raise
+        except Exception:
+            if self._debug:
+                raise
+            return UNKNOWN
+
+    def _eval_inner(self, frame: _Frame, node: ast.expr) -> object:
+        if isinstance(node, ast.Constant):
+            return Const(node.value)
+        if isinstance(node, ast.Name):
+            return self._eval_name(frame, node)
+        if isinstance(node, ast.Attribute):
+            return self._eval_attribute(frame, node)
+        if isinstance(node, ast.Subscript):
+            return self._index(frame, node)
+        if isinstance(node, ast.Call):
+            return self._eval_call(frame, node)
+        if isinstance(node, ast.BinOp):
+            left = self._eval(frame, node.left)
+            right = self._eval(frame, node.right)
+            return self._binop(frame, node.op, left, right, node.lineno)
+        if isinstance(node, ast.UnaryOp):
+            val = self._eval(frame, node.operand)
+            if isinstance(node.op, ast.USub):
+                if isinstance(val, Const) and isinstance(val.value, (int, float)):
+                    return Const(-val.value)
+                if isinstance(val, Arr):
+                    return val
+                return UNKNOWN
+            if isinstance(node.op, ast.UAdd):
+                return val
+            if isinstance(node.op, ast.Not):
+                truth = _truthiness(val)
+                return Const(not truth) if truth is not None else UNKNOWN
+            if isinstance(node.op, ast.Invert) and isinstance(val, Arr):
+                return val
+            return UNKNOWN
+        if isinstance(node, ast.Compare):
+            return self._compare(frame, node)
+        if isinstance(node, ast.BoolOp):
+            vals = [self._eval(frame, v) for v in node.values]
+            out = vals[0]
+            for v in vals[1:]:
+                out = self._join(out, v)
+            return out
+        if isinstance(node, ast.IfExp):
+            cond = self._eval(frame, node.test)
+            truth = _truthiness(cond)
+            if truth is True:
+                return self._eval(frame, node.body)
+            if truth is False:
+                return self._eval(frame, node.orelse)
+            return self._join(self._eval(frame, node.body),
+                              self._eval(frame, node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List)):
+            if any(isinstance(e, ast.Starred) for e in node.elts):
+                return UNKNOWN
+            return TupVal(tuple(self._eval(frame, e) for e in node.elts))
+        if isinstance(node, ast.Lambda):
+            return FnVal(module=frame.module, node=node, closure=frame.env)
+        if isinstance(node, ast.NamedExpr):
+            val = self._eval(frame, node.value)
+            self._assign(frame, node.target, val)
+            return val
+        if isinstance(node, ast.Starred):
+            return UNKNOWN
+        # Dict/Set/comprehensions/f-strings/await/yield: opaque.
+        return UNKNOWN
+
+    def _eval_name(self, frame: _Frame, node: ast.Name) -> object:
+        name = node.id
+        if name in frame.env:
+            return frame.env[name]
+        module_env = self._module_env.get(id(frame.module))
+        if module_env is not None and name in module_env:
+            return module_env[name]
+        if name in ("True", "False", "None"):  # pre-3.8 trees only
+            return Const({"True": True, "False": False, "None": None}[name])
+        if name in ("bool", "int", "float", "complex"):
+            return DtypeVal({"bool": "bool", "int": "int32",
+                             "float": "float32", "complex": "complex64"}[name])
+        aliases = self.graph.aliases(frame.module)
+        target = aliases.get(name)
+        if target is not None:
+            return self._resolve_dotted(frame, target)
+        fi = self.graph.resolve_function(frame.module, name)
+        if fi is not None:
+            return FnVal(module=fi.module, node=fi.node)
+        s = self.graph.resolve_string(frame.module, node)
+        if s is not None:
+            return Const(s)
+        if name in __builtins__ if isinstance(__builtins__, dict) else hasattr(__builtins__, name):
+            return FnVal(builtin=name)
+        return UNKNOWN
+
+    def _resolve_dotted(self, frame: _Frame, name: str) -> object:
+        """The value a canonical dotted name denotes (dtype, const,
+        project function, cross-module global, or a ModRef prefix)."""
+        if name in _ATTR_CONSTS:
+            return Const(_ATTR_CONSTS[name])
+        head, _, tail = name.rpartition(".")
+        if tail in _DTYPE_NAMES and head in ("jax.numpy", "numpy", "jax.dtypes"):
+            return DtypeVal(tail)
+        fi = self.graph.resolve_function(frame.module, name)
+        if fi is not None:
+            return FnVal(module=fi.module, node=fi.node)
+        if head in self._by_name:
+            owner = self._by_name[head]
+            env = self._env_of(owner)
+            if tail in env:
+                return env[tail]
+        return ModRef(name)
+
+    _ARR_REDUCE_METHODS = _REDUCTIONS | {"ptp"}
+
+    def _eval_attribute(self, frame: _Frame, node: ast.Attribute) -> object:
+        # Prefer whole-chain dotted resolution when the base name is not a
+        # local binding (``jnp.float32``, ``np.inf``, ``mod.fn``).
+        base = node
+        while isinstance(base, ast.Attribute):
+            base = base.value
+        aliases = self.graph.aliases(frame.module)
+        if isinstance(base, ast.Name) and base.id not in frame.env and \
+                base.id not in self._module_env.get(id(frame.module), {}):
+            name = dotted(node, aliases)
+            if name is not None:
+                resolved = self._resolve_dotted(frame, name)
+                if not isinstance(resolved, ModRef):
+                    return resolved
+                return resolved
+        val = self._eval(frame, node.value)
+        attr = node.attr
+        if isinstance(val, Arr):
+            if attr == "shape":
+                if val.dims is None:
+                    return UNKNOWN
+                return TupVal(tuple(_dim_to_val(d) for d in val.dims))
+            if attr == "dtype":
+                return DtypeVal(val.dtype) if val.dtype else UNKNOWN
+            if attr == "ndim":
+                return Const(len(val.dims)) if val.dims is not None else UNKNOWN
+            if attr == "size":
+                if val.dims is not None and all(isinstance(d, int) for d in val.dims):
+                    n = 1
+                    for d in val.dims:
+                        n *= d
+                    return Const(n)
+                return UNKNOWN
+            if attr == "T":
+                if val.dims is None:
+                    return Arr(None, val.dtype)
+                return Arr(tuple(reversed(val.dims)), val.dtype,
+                           chain=extend_chain(val.chain, node.lineno,
+                                              f".T -> {fmt_dims(tuple(reversed(val.dims)))}"))
+            if attr == "at":
+                return MethodVal(val, "at")
+            return MethodVal(val, attr)
+        if isinstance(val, AtIdxVal):
+            return MethodVal(val, attr)
+        if isinstance(val, MeshVal):
+            if attr == "shape":
+                return MeshShapeVal(val)
+            if attr == "axis_names":
+                return TupVal(tuple(Const(a) for a in val.axes))
+            if attr == "size":
+                n = 1
+                for s in val.sizes:
+                    if not isinstance(s, int):
+                        return UNKNOWN
+                    n *= s
+                return Const(n)
+            return UNKNOWN
+        if isinstance(val, MethodVal) and val.attr == "at":
+            return UNKNOWN
+        if isinstance(val, ModRef):
+            return self._resolve_dotted(frame, f"{val.name}.{attr}")
+        if isinstance(val, (TupVal, Const, ShardingVal, SpecVal)):
+            return MethodVal(val, attr)
+        return UNKNOWN
+
+    def _compare(self, frame: _Frame, node: ast.Compare) -> object:
+        left = self._eval(frame, node.left)
+        result: object = None
+        for op, rhs_node in zip(node.ops, node.comparators):
+            right = self._eval(frame, rhs_node)
+            one = self._compare_one(frame, op, left, right, node.lineno)
+            result = one if result is None else self._join(result, one)
+            left = right
+        return result if result is not None else UNKNOWN
+
+    def _compare_one(self, frame: _Frame, op, left, right, line) -> object:
+        if isinstance(left, Arr) or isinstance(right, Arr):
+            return self._broadcast_op(frame, left, right, line,
+                                      "comparison", bool_result=True)
+        if isinstance(left, Const) and isinstance(right, Const):
+            try:
+                if isinstance(op, ast.Eq):
+                    return Const(left.value == right.value)
+                if isinstance(op, ast.NotEq):
+                    return Const(left.value != right.value)
+                if isinstance(op, ast.Lt):
+                    return Const(left.value < right.value)
+                if isinstance(op, ast.LtE):
+                    return Const(left.value <= right.value)
+                if isinstance(op, ast.Gt):
+                    return Const(left.value > right.value)
+                if isinstance(op, ast.GtE):
+                    return Const(left.value >= right.value)
+                if isinstance(op, ast.In):
+                    return Const(left.value in right.value)
+                if isinstance(op, ast.NotIn):
+                    return Const(left.value not in right.value)
+                if isinstance(op, ast.Is):
+                    return Const(left.value is right.value)
+                if isinstance(op, ast.IsNot):
+                    return Const(left.value is not right.value)
+            except Exception:
+                return UNKNOWN
+        return UNKNOWN
+
+    # -- operators ---------------------------------------------------------
+
+    def _binop(self, frame: _Frame, op, left, right, line: int) -> object:
+        if isinstance(op, ast.MatMult):
+            return self._matmul(frame, left, right, line, {})
+        if isinstance(left, Arr) or isinstance(right, Arr):
+            opname = type(op).__name__.lower()
+            return self._broadcast_op(frame, left, right, line, opname)
+        if isinstance(left, Const) and isinstance(right, Const):
+            lv, rv = left.value, right.value
+            num = (int, float)
+            if isinstance(lv, num) and isinstance(rv, num) and \
+                    not isinstance(lv, bool) and not isinstance(rv, bool):
+                try:
+                    if isinstance(op, ast.Add):
+                        return Const(lv + rv)
+                    if isinstance(op, ast.Sub):
+                        return Const(lv - rv)
+                    if isinstance(op, ast.Mult):
+                        return Const(lv * rv)
+                    if isinstance(op, ast.Div):
+                        return Const(lv / rv)
+                    if isinstance(op, ast.FloorDiv):
+                        return Const(lv // rv)
+                    if isinstance(op, ast.Mod):
+                        return Const(lv % rv)
+                    if isinstance(op, ast.Pow):
+                        return Const(lv ** rv)
+                except Exception:
+                    return UNKNOWN
+            if isinstance(lv, str) and isinstance(rv, str) and \
+                    isinstance(op, ast.Add):
+                return Const(lv + rv)
+            if isinstance(lv, tuple) and isinstance(rv, tuple) and \
+                    isinstance(op, ast.Add):
+                return Const(lv + rv)
+        if isinstance(left, TupVal) and isinstance(right, TupVal) and \
+                isinstance(op, ast.Add):
+            return TupVal(left.items + right.items)
+        return UNKNOWN
+
+    def _operand_info(self, val: object):
+        """(dims, dtype, weak, chain) of one broadcast operand."""
+        if isinstance(val, Arr):
+            return val.dims, val.dtype, False, val.chain
+        if isinstance(val, Const) and isinstance(val.value, (int, float, bool)):
+            return (), None, True, ()  # python scalar: weak type
+        if isinstance(val, Sym):
+            return (), None, True, ()
+        return None, None, True, ()
+
+    def _broadcast_op(self, frame: _Frame, left, right, line: int,
+                      opname: str, bool_result: bool = False) -> object:
+        ldims, ldt, lweak, lchain = self._operand_info(left)
+        rdims, rdt, rweak, rchain = self._operand_info(right)
+        if not isinstance(left, (Arr, Const, Sym)) or \
+                not isinstance(right, (Arr, Const, Sym)):
+            return UNKNOWN
+        dims = self._broadcast_dims(frame, ldims, rdims, line, opname,
+                                    lchain or rchain, left, right)
+        if bool_result:
+            dtype: Optional[str] = "bool"
+        elif lweak and not rweak:
+            dtype = rdt
+        elif rweak and not lweak:
+            dtype = ldt
+        else:
+            dtype = promote_dtype(ldt, rdt)
+        chain = lchain if isinstance(left, Arr) else rchain
+        out = Arr(dims, dtype, chain=chain)
+        if not bool_result:
+            self._check_promotion(frame, line, out, (ldt, rdt), opname)
+        if isinstance(out.dims, tuple):
+            out.chain = extend_chain(
+                chain, line, f"{opname} -> {fmt_arr(out)}"
+            )
+        return out
+
+    def _broadcast_dims(self, frame: _Frame, ldims, rdims, line: int,
+                        opname: str, chain: Chain, left=None, right=None):
+        if ldims is None or rdims is None:
+            return None
+        out: List[object] = []
+        la, ra = list(ldims), list(rdims)
+        while len(la) < len(ra):
+            la.insert(0, 1)
+        while len(ra) < len(la):
+            ra.insert(0, 1)
+        for dl, dr in zip(la, ra):
+            if isinstance(dl, int) and isinstance(dr, int):
+                if dl == dr or dr == 1:
+                    out.append(dl if dr == 1 or dl == dr else dr)
+                elif dl == 1:
+                    out.append(dr)
+                else:
+                    lrend = fmt_arr(left) if isinstance(left, Arr) else repr(dl)
+                    rrend = fmt_arr(right) if isinstance(right, Arr) else repr(dr)
+                    self._emit(
+                        "shape-mismatch", frame, line,
+                        f"operands of {opname} do not broadcast: "
+                        f"{lrend} vs {rrend} (dim {dl} vs {dr}, neither is 1)",
+                        chain,
+                    )
+                    out.append(DYN)
+            elif dl is dr:
+                out.append(dl)
+            elif isinstance(dl, int) and dl == 1:
+                out.append(dr)
+            elif isinstance(dr, int) and dr == 1:
+                out.append(dl)
+            else:
+                out.append(DYN)
+        return tuple(out)
+
+    def _check_promotion(self, frame: _Frame, line: int, result: Arr,
+                         operand_dtypes, opname: str) -> None:
+        """dtype-promotion: rank>=1 float64 appearing from mixed operands
+        inside traced code. Rank-0 f64 scalars are ignored (JAX's default
+        x64-disabled canonicalization makes them harmless weak scalars)."""
+        if not frame.traced or result.dtype != "float64":
+            return
+        if result.dims is None or len(result.dims) == 0:
+            return
+        known = [d for d in operand_dtypes if d]
+        if not known or all(d == "float64" for d in known):
+            return
+        fromtxt = " x ".join(sorted(set(known)))
+        self._emit(
+            "dtype-promotion", frame, line,
+            f"{opname} promotes {fromtxt} to a float64 array "
+            f"({fmt_arr(result)}) inside traced code; TPUs have no f64 "
+            "units and default x64-disabled JAX silently truncates — cast "
+            "the operand to float32 (or jnp.asarray it) instead",
+            result.chain,
+        )
+
+    # -- calls -------------------------------------------------------------
+
+    def _eval_call(self, frame: _Frame, node: ast.Call) -> object:
+        aliases = self.graph.aliases(frame.module)
+        args_unknown = any(isinstance(a, ast.Starred) for a in node.args)
+        args = [] if args_unknown else [self._eval(frame, a) for a in node.args]
+        if args_unknown:
+            for a in node.args:
+                inner = a.value if isinstance(a, ast.Starred) else a
+                self._eval(frame, inner)
+        kwargs, kw_splat = self._eval_kwargs(frame, node)
+
+        base = node.func
+        while isinstance(base, ast.Attribute):
+            base = base.value
+        base_local = (
+            isinstance(base, ast.Name)
+            and (base.id in frame.env
+                 or base.id in self._module_env.get(id(frame.module), {}))
+        )
+        name = None if base_local else callee_name(node, aliases)
+        if name is not None:
+            out = self._call_builtin(frame, name, args, kwargs, node,
+                                     args_unknown, kw_splat)
+            if out is not NotImplemented:
+                return out
+            fi = self.graph.resolve_function(frame.module, name)
+            if fi is not None:
+                if args_unknown:
+                    return UNKNOWN
+                return self._call_project(
+                    fi.module, fi.node, None, args, kwargs, frame,
+                    node.lineno, kw_unknown=kw_splat)
+        func = self._eval(frame, node.func)
+        if args_unknown:
+            return UNKNOWN
+        return self._call_value(frame, func, args, kwargs, node.lineno,
+                                kw_unknown=kw_splat)
+
+    def _call_value(self, frame: _Frame, func: object, args: List[object],
+                    kwargs: Dict[str, object], line: int,
+                    kw_unknown: bool = False) -> object:
+        if isinstance(func, FnVal):
+            merged_args = list(func.bound_args) + list(args)
+            merged_kwargs = dict(func.bound_kwargs or {})
+            merged_kwargs.update(kwargs)
+            kw_unk = kw_unknown or func.kw_unknown
+            if func.builtin is not None:
+                out = self._call_builtin(frame, func.builtin, merged_args,
+                                         merged_kwargs, None, False, kw_unk,
+                                         line=line)
+                return UNKNOWN if out is NotImplemented else out
+            if func.node is not None:
+                return self._call_project(
+                    func.module, func.node, func.closure, merged_args,
+                    merged_kwargs, frame, line, kw_unknown=kw_unk)
+            return UNKNOWN
+        if isinstance(func, XformVal):
+            return self._apply_xform(frame, func, args, kwargs, line)
+        if isinstance(func, MethodVal):
+            return self._call_method(frame, func.obj, func.attr, args,
+                                     kwargs, line)
+        if isinstance(func, DtypeVal):
+            if len(args) == 1:
+                return self._cast(frame, args[0], func.name, line)
+            return UNKNOWN
+        if isinstance(func, LayerVal):
+            return self._call_layer(frame, func, args, kwargs, line)
+        return UNKNOWN
+
+    def _cast(self, frame: _Frame, val: object, dtype: str, line: int) -> object:
+        """``jnp.float32(x)`` / ``x.astype(dt)`` — explicit, never flagged."""
+        if isinstance(val, Const) and isinstance(val.value, (int, float, bool)):
+            try:
+                if dtype == "bool":
+                    return Const(bool(val.value))
+                if dtype.startswith(("int", "uint")):
+                    return Const(int(val.value))
+                if dtype.startswith(("float", "bfloat")):
+                    return Arr((), dtype)
+            except Exception:
+                return UNKNOWN
+        if isinstance(val, Arr):
+            return Arr(val.dims, dtype, val.spec,
+                       extend_chain(val.chain, line, f"astype {dtype}"))
+        return UNKNOWN
+
+    def _call_project(self, module: Optional[ModuleInfo], node, closure,
+                      args: List[object], kwargs: Dict[str, object],
+                      frame: _Frame, line: int, kw_unknown: bool,
+                      contract_defaults: bool = False) -> object:
+        """Interpret a project function call; returns the joined return."""
+        if module is None or node is None:
+            return UNKNOWN
+        if frame.depth >= _MAX_DEPTH or id(node) in frame.stack:
+            return UNKNOWN
+        env: Dict[str, object] = dict(closure) if closure else {}
+        a = node.args
+        pos_params = [p.arg for p in a.posonlyargs + a.args]
+        defaults = list(a.defaults)
+        default_for: Dict[str, ast.expr] = {}
+        for pname, dnode in zip(pos_params[len(pos_params) - len(defaults):],
+                                defaults):
+            default_for[pname] = dnode
+        for pname, dnode in zip([p.arg for p in a.kwonlyargs], a.kw_defaults):
+            if dnode is not None:
+                default_for[pname] = dnode
+        all_params = pos_params + [p.arg for p in a.kwonlyargs]
+
+        def bind_default(pname: str) -> object:
+            dnode = default_for.get(pname)
+            if dnode is None:
+                return UNKNOWN
+            dframe = _Frame(module=module,
+                            env=dict(self._module_env.get(id(module), {})),
+                            traced=False, axis_env={}, depth=frame.depth,
+                            stack=frame.stack)
+            return self._eval(dframe, dnode)
+
+        for i, pname in enumerate(pos_params):
+            if i < len(args):
+                env[pname] = args[i]
+            elif pname in kwargs:
+                env[pname] = kwargs[pname]
+            elif kw_unknown:
+                env[pname] = UNKNOWN
+            else:
+                env[pname] = bind_default(pname)
+        for pname in [p.arg for p in a.kwonlyargs]:
+            if pname in kwargs:
+                env[pname] = kwargs[pname]
+            elif kw_unknown:
+                env[pname] = UNKNOWN
+            else:
+                env[pname] = bind_default(pname)
+        if a.vararg is not None:
+            extra = args[len(pos_params):]
+            env[a.vararg.arg] = TupVal(tuple(extra)) if extra else TupVal(())
+        if a.kwarg is not None:
+            env[a.kwarg.arg] = UNKNOWN
+        if contract_defaults:
+            # entry interpretation: contract-named params only; the rest
+            # keep UNKNOWN (kwargs here IS the contract table)
+            for pname in all_params:
+                if pname not in kwargs:
+                    env.setdefault(pname, UNKNOWN)
+
+        inner = _Frame(
+            module=module, env=env, traced=frame.traced,
+            axis_env=dict(frame.axis_env), depth=frame.depth + 1,
+            stack=frame.stack | {id(node)},
+        )
+        body = node.body if isinstance(node.body, list) else None
+        if body is None:  # lambda
+            return self._eval(inner, node.body)
+        self._exec_block(inner, body)
+        if not inner.returns:
+            return Const(None)
+        out = inner.returns[0]
+        for other in inner.returns[1:]:
+            out = self._join(out, other)
+        return out
+
+    # -- subscripting ------------------------------------------------------
+
+    def _index(self, frame: _Frame, node: ast.Subscript) -> object:
+        base = self._eval(frame, node.value)
+        sl = node.slice
+        if isinstance(base, TupVal):
+            idx = self._eval(frame, sl) if not isinstance(sl, ast.Slice) else None
+            if isinstance(sl, ast.Slice):
+                lo = self._eval(frame, sl.lower) if sl.lower else Const(None)
+                hi = self._eval(frame, sl.upper) if sl.upper else Const(None)
+                st = self._eval(frame, sl.step) if sl.step else Const(None)
+                if all(isinstance(v, Const) for v in (lo, hi, st)):
+                    try:
+                        return TupVal(tuple(
+                            base.items[slice(lo.value, hi.value, st.value)]))
+                    except Exception:
+                        return UNKNOWN
+                return UNKNOWN
+            if isinstance(idx, Const) and isinstance(idx.value, int):
+                try:
+                    return base.items[idx.value]
+                except IndexError:
+                    return UNKNOWN
+            return UNKNOWN
+        if isinstance(base, MeshShapeVal):
+            idx = self._eval(frame, sl)
+            if isinstance(idx, Const) and isinstance(idx.value, str):
+                mesh = base.mesh
+                if idx.value in mesh.axes:
+                    size = mesh.sizes[mesh.axes.index(idx.value)]
+                    return Const(size) if isinstance(size, int) else UNKNOWN
+            return UNKNOWN
+        if isinstance(base, AtIdxVal):
+            return base
+        if isinstance(base, MethodVal) and base.attr == "at":
+            # x.at[idx] — remember the array, updates preserve its shape
+            if isinstance(base.obj, Arr):
+                self._eval(frame, sl) if not isinstance(sl, ast.Slice) else None
+                return AtIdxVal(base.obj)
+            return UNKNOWN
+        if isinstance(base, Const) and isinstance(base.value, (tuple, str)):
+            idx = self._eval(frame, sl) if not isinstance(sl, ast.Slice) else None
+            if isinstance(idx, Const) and isinstance(idx.value, int):
+                try:
+                    return Const(base.value[idx.value])
+                except Exception:
+                    return UNKNOWN
+            return UNKNOWN
+        if not isinstance(base, Arr):
+            if not isinstance(sl, ast.Slice):
+                self._eval(frame, sl)
+            return UNKNOWN
+        if base.dims is None:
+            return Arr(None, base.dtype)
+        parts = list(sl.elts) if isinstance(sl, ast.Tuple) else [sl]
+        has_ellipsis = any(
+            isinstance(p, ast.Constant) and p.value is Ellipsis for p in parts)
+        front: List[ast.expr] = []
+        back: List[ast.expr] = []
+        seen_ell = False
+        for p in parts:
+            if isinstance(p, ast.Constant) and p.value is Ellipsis:
+                seen_ell = True
+                continue
+            (back if seen_ell else front).append(p)
+        dims = list(base.dims)
+        out_front: List[object] = []
+        out_back: List[object] = []
+
+        def consume(p: ast.expr, dim_pool: List[object], out: List[object],
+                    from_back: bool) -> bool:
+            """Apply one index part; returns False on fancy/unknown rank."""
+            if isinstance(p, ast.Slice):
+                if not dim_pool:
+                    return True
+                d = dim_pool.pop(0 if not from_back else -1)
+                lo = self._eval(frame, p.lower) if p.lower else Const(None)
+                hi = self._eval(frame, p.upper) if p.upper else Const(None)
+                st = self._eval(frame, p.step) if p.step else Const(None)
+                if isinstance(d, int) and all(
+                        isinstance(v, Const) and
+                        (v.value is None or isinstance(v.value, int))
+                        for v in (lo, hi, st)):
+                    try:
+                        newd = len(range(*slice(lo.value, hi.value,
+                                                st.value).indices(d)))
+                    except Exception:
+                        newd = DYN
+                else:
+                    full = (lo.value is None if isinstance(lo, Const) else False) and \
+                           (hi.value is None if isinstance(hi, Const) else False) and \
+                           (st.value is None if isinstance(st, Const) else False)
+                    newd = d if full else DYN
+                out.append(newd)
+                return True
+            val = self._eval(frame, p)
+            if isinstance(val, Const):
+                if val.value is None:
+                    out.append(1)
+                    return True
+                if isinstance(val.value, int):
+                    if dim_pool:
+                        dim_pool.pop(0 if not from_back else -1)
+                    return True
+                return False
+            if isinstance(val, Arr):
+                if val.dims == ():
+                    if dim_pool:
+                        dim_pool.pop(0 if not from_back else -1)
+                    return True
+                return False  # fancy indexing: give up on rank
+            if dim_pool:  # unknown scalar-ish index: drop one dim
+                dim_pool.pop(0 if not from_back else -1)
+            return True
+
+        for p in front:
+            if not consume(p, dims, out_front, from_back=False):
+                return Arr(None, base.dtype)
+        if has_ellipsis:
+            for p in reversed(back):
+                tmp: List[object] = []
+                if not consume(p, dims, tmp, from_back=True):
+                    return Arr(None, base.dtype)
+                out_back = tmp + out_back
+            new_dims = tuple(out_front) + tuple(dims) + tuple(out_back)
+        else:
+            new_dims = tuple(out_front) + tuple(dims)
+        return Arr(new_dims, base.dtype,
+                   chain=extend_chain(base.chain, node.lineno,
+                                      f"index -> {fmt_dims(new_dims)}"))
+
+    # -- matmul / einsum ---------------------------------------------------
+
+    def _matmul(self, frame: _Frame, left, right, line: int,
+                kwargs: Dict[str, object]) -> object:
+        if not isinstance(left, Arr) or not isinstance(right, Arr):
+            return UNKNOWN
+        dtype = self._einsum_dtype(kwargs, left.dtype, right.dtype)
+        if left.dims is None or right.dims is None:
+            return Arr(None, dtype)
+        ld, rd = left.dims, right.dims
+        if len(ld) == 0 or len(rd) == 0:
+            return UNKNOWN
+        lk = ld[-1]
+        rk = rd[-2] if len(rd) >= 2 else rd[-1]
+        if isinstance(lk, int) and isinstance(rk, int) and lk != rk:
+            self._emit(
+                "shape-mismatch", frame, line,
+                f"matmul contracting dims disagree: {fmt_arr(left)} @ "
+                f"{fmt_arr(right)} ({lk} vs {rk})",
+                left.chain or right.chain,
+            )
+            return Arr(None, dtype)
+        if len(ld) == 1 and len(rd) == 1:
+            dims: Tuple = ()
+        elif len(rd) == 1:
+            dims = ld[:-1]
+        elif len(ld) == 1:
+            dims = rd[:-2] + (rd[-1],)
+        else:
+            batch = self._broadcast_dims(frame, ld[:-2], rd[:-2], line,
+                                         "matmul batch", left.chain)
+            if batch is None:
+                return Arr(None, dtype)
+            dims = tuple(batch) + (ld[-2], rd[-1])
+        out = Arr(dims, dtype,
+                  chain=extend_chain(left.chain or right.chain, line,
+                                     f"matmul -> {fmt_dims(dims)}"))
+        return out
+
+    @staticmethod
+    def _einsum_dtype(kwargs: Dict[str, object], *dtypes) -> Optional[str]:
+        pet = kwargs.get("preferred_element_type")
+        if isinstance(pet, DtypeVal):
+            return pet.name
+        out = None
+        for d in dtypes:
+            out = promote_dtype(out, d)
+        return out
+
+    def _einsum(self, frame: _Frame, args: List[object],
+                kwargs: Dict[str, object], line: int) -> object:
+        if not args or not isinstance(args[0], Const) or \
+                not isinstance(args[0].value, str):
+            return UNKNOWN
+        spec = args[0].value.replace(" ", "")
+        operands = args[1:]
+        if "->" not in spec:
+            return UNKNOWN
+        lhs, rhs = spec.split("->", 1)
+        in_specs = lhs.split(",")
+        if len(in_specs) != len(operands):
+            return UNKNOWN
+        if "." in spec:
+            return UNKNOWN  # '...' batching: out of scope, stay silent
+        binding: Dict[str, object] = {}
+        chain: Chain = ()
+        dtypes: List[Optional[str]] = []
+        for ispec, op in zip(in_specs, operands):
+            if not isinstance(op, Arr):
+                return UNKNOWN
+            dtypes.append(op.dtype)
+            chain = chain or op.chain
+            if op.dims is None:
+                for letter in ispec:
+                    binding.setdefault(letter, DYN)
+                continue
+            if len(ispec) != len(op.dims):
+                self._emit(
+                    "shape-mismatch", frame, line,
+                    f"einsum operand '{ispec}' expects rank {len(ispec)} "
+                    f"but got {fmt_arr(op)}",
+                    op.chain,
+                )
+                return UNKNOWN
+            for letter, dim in zip(ispec, op.dims):
+                prev = binding.get(letter)
+                if prev is None:
+                    binding[letter] = dim
+                elif isinstance(prev, int) and isinstance(dim, int) and \
+                        prev != dim:
+                    self._emit(
+                        "shape-mismatch", frame, line,
+                        f"einsum index '{letter}' bound to both {prev} and "
+                        f"{dim} across operands of '{spec}'",
+                        op.chain or chain,
+                    )
+                    binding[letter] = DYN
+                elif prev is not dim and not (
+                        isinstance(prev, int) and isinstance(dim, int)):
+                    if isinstance(dim, int):
+                        binding[letter] = dim
+        dims = tuple(binding.get(letter, DYN) for letter in rhs)
+        dtype = self._einsum_dtype(kwargs, *dtypes)
+        return Arr(dims, dtype,
+                   chain=extend_chain(chain, line,
+                                      f"einsum '{spec}' -> {fmt_dims(dims)}"))
+
+    # -- bound-method calls ------------------------------------------------
+
+    _METHOD_TO_BUILTIN = {
+        "reshape": "jax.numpy.reshape", "transpose": "jax.numpy.transpose",
+        "swapaxes": "jax.numpy.swapaxes", "squeeze": "jax.numpy.squeeze",
+        "sum": "jax.numpy.sum", "mean": "jax.numpy.mean",
+        "max": "jax.numpy.max", "min": "jax.numpy.min",
+        "prod": "jax.numpy.prod", "std": "jax.numpy.std",
+        "var": "jax.numpy.var", "all": "jax.numpy.all",
+        "any": "jax.numpy.any", "argmax": "jax.numpy.argmax",
+        "argmin": "jax.numpy.argmin", "cumsum": "jax.numpy.cumsum",
+        "round": "jax.numpy.round", "clip": "jax.numpy.clip",
+        "ravel": "jax.numpy.ravel", "flatten": "jax.numpy.ravel",
+        "conj": "jax.numpy.conj", "copy": "jax.numpy.copy",
+        "repeat": "jax.numpy.repeat", "take": "jax.numpy.take",
+    }
+
+    def _call_method(self, frame: _Frame, obj: object, attr: str,
+                     args: List[object], kwargs: Dict[str, object],
+                     line: int) -> object:
+        if isinstance(obj, AtIdxVal):
+            if attr in ("set", "add", "subtract", "multiply", "divide",
+                        "min", "max", "power", "apply"):
+                base = obj.arr
+                if args and isinstance(args[0], Arr) and \
+                        isinstance(base, Arr):
+                    pass  # update broadcast against a *slice*; stay silent
+                return base
+            if attr == "get":
+                return UNKNOWN
+            return UNKNOWN
+        if isinstance(obj, Arr):
+            if attr == "astype" and args:
+                dt = args[0]
+                if isinstance(dt, DtypeVal):
+                    return self._cast(frame, obj, dt.name, line)
+                return Arr(obj.dims, None, obj.spec, obj.chain)
+            if attr == "item":
+                return UNKNOWN
+            if attr in ("tolist", "block_until_ready"):
+                return obj if attr == "block_until_ready" else UNKNOWN
+            builtin = self._METHOD_TO_BUILTIN.get(attr)
+            if builtin is not None:
+                out = self._call_builtin(frame, builtin, [obj] + args,
+                                         kwargs, None, False, False,
+                                         line=line)
+                return UNKNOWN if out is NotImplemented else out
+            return UNKNOWN
+        if isinstance(obj, Const):
+            v = obj.value
+            if isinstance(v, str):
+                if attr in ("lower", "upper", "strip", "replace", "format"):
+                    try:
+                        return Const(getattr(v, attr)(*[
+                            a.value for a in args
+                            if isinstance(a, Const)]))
+                    except Exception:
+                        return UNKNOWN
+                if attr in ("startswith", "endswith") and args and \
+                        isinstance(args[0], Const):
+                    try:
+                        return Const(getattr(v, attr)(args[0].value))
+                    except Exception:
+                        return UNKNOWN
+            return UNKNOWN
+        if isinstance(obj, TupVal) and attr == "index":
+            if args and isinstance(args[0], Const):
+                for i, item in enumerate(obj.items):
+                    if isinstance(item, Const) and item.value == args[0].value:
+                        return Const(i)
+            return UNKNOWN
+        return UNKNOWN
+
+    def _call_layer(self, frame: _Frame, layer: LayerVal,
+                    args: List[object], kwargs: Dict[str, object],
+                    line: int) -> object:
+        x = args[0] if args else kwargs.get("inputs")
+        if not isinstance(x, Arr):
+            return UNKNOWN
+        kind, meta = layer.kind, layer.meta
+        if kind == "dense":
+            feat = meta.get("features")
+            f = _known_int(feat)
+            if x.dims is None:
+                return Arr(None, x.dtype)
+            dims = x.dims[:-1] + ((f,) if f is not None else (DYN,))
+            return Arr(dims, x.dtype,
+                       chain=extend_chain(x.chain, line,
+                                          f"Dense -> {fmt_dims(dims)}"))
+        if kind == "conv":
+            return self._conv_shape(frame, x, meta, line)
+        if kind in ("dropout", "norm"):
+            return x
+        return UNKNOWN
+
+    def _conv_shape(self, frame: _Frame, x: Arr, meta: Dict[str, object],
+                    line: int) -> object:
+        """flax.linen.Conv on NHWC input (the convnet case study)."""
+        if x.dims is None or len(x.dims) < 3:
+            return Arr(None, x.dtype)
+        feat = _known_int(meta.get("features"))
+        ks = meta.get("kernel_size")
+        strides = meta.get("strides")
+        padding = meta.get("padding")
+        pad = "SAME"
+        if isinstance(padding, Const) and isinstance(padding.value, str):
+            pad = padding.value.upper()
+        kdims: List[Optional[int]] = []
+        if isinstance(ks, TupVal):
+            for item in ks.items:
+                kdims.append(_known_int(item))
+        sdims: List[Optional[int]] = [1] * len(kdims)
+        if isinstance(strides, TupVal):
+            sdims = [_known_int(i) or 1 for i in strides.items]
+        elif _known_int(strides) is not None:
+            sdims = [_known_int(strides)] * len(kdims)
+        spatial = list(x.dims[1:-1])
+        n_sp = len(kdims) if kdims else len(spatial)
+        out_sp: List[object] = []
+        for i, d in enumerate(spatial):
+            if i >= n_sp or not isinstance(d, int):
+                out_sp.append(d if i >= n_sp else DYN)
+                continue
+            k = kdims[i] if i < len(kdims) else None
+            s = sdims[i] if i < len(sdims) else 1
+            if k is None or s is None:
+                out_sp.append(DYN)
+            elif pad == "SAME":
+                out_sp.append(-(-d // s))
+            else:  # VALID
+                out_sp.append((d - k) // s + 1 if d >= k else DYN)
+        dims = (x.dims[0],) + tuple(out_sp) + \
+               ((feat,) if feat is not None else (DYN,))
+        return Arr(dims, x.dtype,
+                   chain=extend_chain(x.chain, line,
+                                      f"Conv -> {fmt_dims(dims)}"))
+
+    # -- sharding checks ---------------------------------------------------
+
+    def _spec_entries(self, spec: object) -> Optional[Tuple]:
+        if isinstance(spec, SpecVal):
+            return spec.entries
+        if isinstance(spec, ShardingVal) and isinstance(spec.spec, SpecVal):
+            return spec.spec.entries
+        return None
+
+    def _sharding_mesh(self, spec: object) -> Optional[MeshVal]:
+        if isinstance(spec, ShardingVal) and isinstance(spec.mesh, MeshVal):
+            return spec.mesh
+        return None
+
+    def _axis_factor(self, mesh: Optional[MeshVal], axis_env: Dict[str, object],
+                     entry: object) -> Tuple[Optional[str], object]:
+        """(axis label, size) for one PartitionSpec entry; size may be DYN."""
+        names: List[str] = []
+        if isinstance(entry, str):
+            names = [entry]
+        elif isinstance(entry, tuple):
+            names = [e for e in entry if isinstance(e, str)]
+            if len(names) != len(entry):
+                return None, DYN
+        else:
+            return None, DYN
+        total: object = 1
+        for nm in names:
+            size: object = DYN
+            if mesh is not None and nm in mesh.axes:
+                size = mesh.sizes[mesh.axes.index(nm)]
+            elif nm in axis_env:
+                size = axis_env[nm]
+            if not isinstance(size, int):
+                return "+".join(names), DYN
+            total = total * size if isinstance(total, int) else DYN
+        return "+".join(names), total
+
+    def _check_sharding(self, frame: _Frame, arr: object, sharding: object,
+                        line: int, context: str) -> object:
+        """Verify a Spec/NamedSharding against an array; attach the spec."""
+        if not isinstance(arr, Arr):
+            return arr
+        entries = self._spec_entries(sharding)
+        if entries is None:
+            return arr
+        mesh = self._sharding_mesh(sharding)
+        if arr.dims is not None:
+            for i, entry in enumerate(entries):
+                if entry is None or i >= len(arr.dims):
+                    continue
+                label, size = self._axis_factor(mesh, frame.axis_env, entry)
+                if label is None or not isinstance(size, int):
+                    continue
+                dim = arr.dims[i]
+                if isinstance(dim, int) and size > 0 and dim % size != 0:
+                    self._emit(
+                        "indivisible-sharding", frame, line,
+                        f"{context}: dim {i} of {fmt_arr(arr)} is sharded "
+                        f"over mesh axis '{label}' of size {size}, but "
+                        f"{dim} % {size} != 0",
+                        arr.chain,
+                    )
+        return Arr(arr.dims, arr.dtype, entries,
+                   extend_chain(arr.chain, line,
+                                f"{context} {fmt_spec(entries)}"))
+
+    def _carry_check(self, frame: _Frame, init: object, out: object,
+                     line: int, what: str) -> None:
+        if isinstance(init, TupVal) and isinstance(out, TupVal):
+            if len(init.items) != len(out.items):
+                self._emit(
+                    "shape-mismatch", frame, line,
+                    f"{what} carry changes structure: {len(init.items)} "
+                    f"elements in, {len(out.items)} out",
+                    (),
+                )
+                return
+            for a, b in zip(init.items, out.items):
+                self._carry_check(frame, a, b, line, what)
+            return
+        if isinstance(init, Arr) and isinstance(out, Arr):
+            if init.dims is None or out.dims is None:
+                return
+            if len(init.dims) != len(out.dims):
+                self._emit(
+                    "shape-mismatch", frame, line,
+                    f"{what} carry changes rank: {fmt_arr(init)} in, "
+                    f"{fmt_arr(out)} out",
+                    out.chain or init.chain,
+                )
+                return
+            for a, b in zip(init.dims, out.dims):
+                if isinstance(a, int) and isinstance(b, int) and a != b:
+                    self._emit(
+                        "shape-mismatch", frame, line,
+                        f"{what} carry changes shape: {fmt_arr(init)} in, "
+                        f"{fmt_arr(out)} out",
+                        out.chain or init.chain,
+                    )
+                    return
+
+    # -- transforms --------------------------------------------------------
+
+    def _apply_xform(self, frame: _Frame, xf: XformVal, args: List[object],
+                     kwargs: Dict[str, object], line: int) -> object:
+        kind, fn, meta = xf.kind, xf.fn, xf.meta
+        if not isinstance(fn, (FnVal, XformVal)):
+            return UNKNOWN
+        if kind == "jit":
+            in_sh = meta.get("in_shardings")
+            checked = list(args)
+            if isinstance(in_sh, TupVal):
+                for i, sh in enumerate(in_sh.items):
+                    if i < len(checked):
+                        checked[i] = self._check_sharding(
+                            frame, checked[i], sh, line, "pjit in_shardings")
+            elif in_sh is not None and args:
+                checked[0] = self._check_sharding(
+                    frame, checked[0], in_sh, line, "pjit in_shardings")
+            inner = self._traced(frame)
+            return self._call_value(inner, fn, checked, kwargs, line)
+        if kind in ("grad", "value_and_grad"):
+            inner = self._traced(frame)
+            ret = self._call_value(inner, fn, args, kwargs, line)
+            grad_like = args[0] if args else UNKNOWN
+            if kind == "grad":
+                return grad_like
+            return TupVal((ret, grad_like))
+        if kind in ("vmap", "pmap"):
+            return self._apply_vmap(frame, kind, fn, meta, args, kwargs, line)
+        if kind == "shard_map":
+            return self._apply_shard_map(frame, fn, meta, args, kwargs, line)
+        if kind == "pallas_call":
+            out_shape = meta.get("out_shape")
+            if isinstance(out_shape, Arr):
+                return out_shape
+            if isinstance(out_shape, TupVal):
+                return out_shape
+            return UNKNOWN
+        return UNKNOWN
+
+    def _traced(self, frame: _Frame) -> _Frame:
+        if frame.traced:
+            return frame
+        inner = self._fork(frame, frame.env)
+        inner.traced = True
+        return inner
+
+    def _apply_vmap(self, frame: _Frame, kind: str, fn: object,
+                    meta: Dict[str, object], args: List[object],
+                    kwargs: Dict[str, object], line: int) -> object:
+        in_axes = meta.get("in_axes", Const(0))
+        out_axes = meta.get("out_axes", Const(0))
+        per_arg: List[object]
+        if isinstance(in_axes, TupVal):
+            if args and len(in_axes.items) != len(args):
+                self._emit(
+                    "vmap-axis-clash", frame, line,
+                    f"{kind} in_axes has {len(in_axes.items)} entries but "
+                    f"the mapped function is called with {len(args)} "
+                    "positional arguments",
+                    (),
+                )
+                return UNKNOWN
+            per_arg = list(in_axes.items)
+        else:
+            per_arg = [in_axes] * len(args)
+
+        mapped_size: object = DYN
+        stripped: List[object] = []
+        for i, (arg, ax) in enumerate(zip(args, per_arg)):
+            axis = ax.value if isinstance(ax, Const) else None
+            if axis is None and isinstance(ax, Const):
+                stripped.append(arg)  # in_axes=None: broadcast, keep as-is
+                continue
+            if not isinstance(arg, Arr) or arg.dims is None:
+                stripped.append(UNKNOWN if isinstance(arg, Arr) else arg)
+                continue
+            if not isinstance(axis, int):
+                stripped.append(Arr(None, arg.dtype))
+                continue
+            rank = len(arg.dims)
+            if axis >= rank or axis < -rank:
+                self._emit(
+                    "vmap-axis-clash", frame, line,
+                    f"{kind} in_axes[{i}]={axis} is out of range for "
+                    f"argument {i} of rank {rank} ({fmt_arr(arg)})",
+                    arg.chain,
+                )
+                stripped.append(Arr(None, arg.dtype))
+                continue
+            norm = axis % rank
+            size = arg.dims[norm]
+            if kind == "vmap":
+                if isinstance(size, int):
+                    if isinstance(mapped_size, int) and mapped_size != size:
+                        self._emit(
+                            "vmap-axis-clash", frame, line,
+                            f"vmap mapped-axis sizes disagree: argument "
+                            f"{i} maps dim of size {size} but an earlier "
+                            f"argument mapped size {mapped_size}",
+                            arg.chain,
+                        )
+                    elif mapped_size is DYN:
+                        mapped_size = size
+                elif isinstance(size, Sym) and mapped_size is DYN:
+                    mapped_size = size
+            dims = arg.dims[:norm] + arg.dims[norm + 1:]
+            stripped.append(Arr(dims, arg.dtype, arg.spec,
+                                extend_chain(arg.chain, line,
+                                             f"{kind} strip axis {axis} -> "
+                                             f"{fmt_dims(dims)}")))
+        if kind == "pmap":
+            mapped_size = DYN
+            axis_name = meta.get("axis_name")
+            inner_axis_env = dict(frame.axis_env)
+            if isinstance(axis_name, Const) and \
+                    isinstance(axis_name.value, str):
+                inner_axis_env[axis_name.value] = DYN
+        else:
+            inner_axis_env = dict(frame.axis_env)
+            axis_name = meta.get("axis_name")
+            if isinstance(axis_name, Const) and \
+                    isinstance(axis_name.value, str):
+                inner_axis_env[axis_name.value] = mapped_size
+
+        inner = self._fork(frame, frame.env)
+        inner.traced = True
+        inner.axis_env = inner_axis_env
+        ret = self._call_value(inner, fn, stripped, kwargs, line)
+
+        oax = out_axes.value if isinstance(out_axes, Const) else 0
+        if oax is None:
+            return ret
+
+        def put_back(v: object) -> object:
+            if isinstance(v, Arr):
+                if v.dims is None:
+                    return Arr(None, v.dtype)
+                k = oax if isinstance(oax, int) else 0
+                if k < 0:
+                    k = len(v.dims) + 1 + k
+                k = max(0, min(k, len(v.dims)))
+                dims = v.dims[:k] + (mapped_size,) + v.dims[k:]
+                return Arr(dims, v.dtype, v.spec,
+                           extend_chain(v.chain, line,
+                                        f"{kind} out -> {fmt_dims(dims)}"))
+            if isinstance(v, TupVal):
+                return TupVal(tuple(put_back(i) for i in v.items))
+            return UNKNOWN if v is not None else v
+        return put_back(ret)
+
+    def _apply_shard_map(self, frame: _Frame, fn: object,
+                         meta: Dict[str, object], args: List[object],
+                         kwargs: Dict[str, object], line: int) -> object:
+        mesh = meta.get("mesh")
+        in_specs = meta.get("in_specs")
+        out_specs = meta.get("out_specs")
+        meshv = mesh if isinstance(mesh, MeshVal) else None
+
+        specs_list: List[object]
+        if isinstance(in_specs, TupVal):
+            specs_list = list(in_specs.items)
+        elif in_specs is not None:
+            specs_list = [in_specs] * len(args)
+        else:
+            specs_list = []
+
+        inner_axis_env = dict(frame.axis_env)
+        if meshv is not None:
+            for ax, size in zip(meshv.axes, meshv.sizes):
+                inner_axis_env[ax] = size if isinstance(size, int) else DYN
+
+        def shard_one(arr: object, spec: object) -> object:
+            if not isinstance(arr, Arr) or arr.dims is None:
+                return arr
+            entries = self._spec_entries(spec)
+            if entries is None:
+                return Arr(None, arr.dtype)
+            dims = list(arr.dims)
+            for i, entry in enumerate(entries):
+                if entry is None or i >= len(dims):
+                    continue
+                label, size = self._axis_factor(meshv, frame.axis_env, entry)
+                d = dims[i]
+                if not isinstance(size, int):
+                    dims[i] = DYN
+                    continue
+                if isinstance(d, int):
+                    if size > 0 and d % size != 0:
+                        self._emit(
+                            "indivisible-sharding", frame, line,
+                            f"shard_map in_specs: dim {i} of {fmt_arr(arr)} "
+                            f"is sharded over mesh axis '{label}' of size "
+                            f"{size}, but {d} % {size} != 0",
+                            arr.chain,
+                        )
+                        dims[i] = DYN
+                    else:
+                        dims[i] = d // size
+                else:
+                    dims[i] = DYN
+            new = tuple(dims)
+            return Arr(new, arr.dtype, None,
+                       extend_chain(arr.chain, line,
+                                    f"shard_map shard -> {fmt_dims(new)}"))
+
+        sharded = [shard_one(a, specs_list[i] if i < len(specs_list) else None)
+                   for i, a in enumerate(args)]
+        inner = self._fork(frame, frame.env)
+        inner.traced = True
+        inner.axis_env = inner_axis_env
+        ret = self._call_value(inner, fn, sharded, kwargs, line)
+
+        def unshard_one(v: object, spec: object) -> object:
+            if not isinstance(v, Arr) or v.dims is None:
+                return v
+            entries = self._spec_entries(spec)
+            if entries is None:
+                return Arr(None, v.dtype)
+            dims = list(v.dims)
+            for i, entry in enumerate(entries):
+                if entry is None or i >= len(dims):
+                    continue
+                label, size = self._axis_factor(meshv, frame.axis_env, entry)
+                d = dims[i]
+                if isinstance(size, int) and isinstance(d, int):
+                    dims[i] = d * size
+                else:
+                    dims[i] = DYN
+            new = tuple(dims)
+            return Arr(new, v.dtype, entries,
+                       extend_chain(v.chain, line,
+                                    f"shard_map gather -> {fmt_dims(new)}"))
+
+        if isinstance(ret, TupVal) and isinstance(out_specs, TupVal) and \
+                len(ret.items) == len(out_specs.items):
+            return TupVal(tuple(unshard_one(v, s) for v, s in
+                                zip(ret.items, out_specs.items)))
+        if isinstance(ret, TupVal):
+            return TupVal(tuple(unshard_one(v, out_specs)
+                                for v in ret.items))
+        return unshard_one(ret, out_specs)
+
+    # -- builtin vocabulary ------------------------------------------------
+
+    def _dims_of(self, val: object) -> Optional[Tuple[object, ...]]:
+        """A shape-like value as a dims tuple, else None."""
+        if isinstance(val, TupVal):
+            return tuple(_val_to_dim(i) for i in val.items)
+        if isinstance(val, Const):
+            if isinstance(val.value, int) and not isinstance(val.value, bool):
+                return (val.value,)
+            if isinstance(val.value, tuple) and all(
+                    isinstance(v, int) for v in val.value):
+                return tuple(val.value)
+        if isinstance(val, Sym):
+            return (val,)
+        return None
+
+    @staticmethod
+    def _dtype_of(val: object) -> Optional[str]:
+        if isinstance(val, DtypeVal):
+            return val.name
+        if isinstance(val, Const) and isinstance(val.value, str) and \
+                val.value in _DTYPE_NAMES:
+            return val.value
+        return None
+
+    def _axis_size(self, frame: _Frame, axis_name: object) -> object:
+        if isinstance(axis_name, Const) and isinstance(axis_name.value, str):
+            return frame.axis_env.get(axis_name.value, DYN)
+        return DYN
+
+    @staticmethod
+    def _axis_arg(args: List[object], kwargs: Dict[str, object],
+                  pos: int = 1) -> object:
+        if "axis" in kwargs:
+            return kwargs["axis"]
+        if len(args) > pos:
+            return args[pos]
+        return None
+
+    def _reduce_dims(self, arr: Arr, axis_val: object,
+                     keepdims: object) -> Optional[Tuple[object, ...]]:
+        if arr.dims is None:
+            return None
+        keep = isinstance(keepdims, Const) and keepdims.value is True
+        if axis_val is None or (isinstance(axis_val, Const) and
+                                axis_val.value is None):
+            return tuple(1 for _ in arr.dims) if keep else ()
+        axes: List[int] = []
+        if isinstance(axis_val, Const) and isinstance(axis_val.value, int):
+            axes = [axis_val.value]
+        elif isinstance(axis_val, TupVal):
+            for item in axis_val.items:
+                k = _known_int(item)
+                if k is None:
+                    return None
+                axes.append(k)
+        else:
+            return None
+        rank = len(arr.dims)
+        norm = set()
+        for a in axes:
+            if -rank <= a < rank:
+                norm.add(a % rank)
+            else:
+                return None
+        if keep:
+            return tuple(1 if i in norm else d
+                         for i, d in enumerate(arr.dims))
+        return tuple(d for i, d in enumerate(arr.dims) if i not in norm)
+
+    def _call_builtin(self, frame: _Frame, name: str, args: List[object],
+                      kwargs: Dict[str, object], node: Optional[ast.Call],
+                      args_unknown: bool = False, kw_splat: bool = False,
+                      line: Optional[int] = None) -> object:
+        ln = node.lineno if node is not None else (line or 0)
+        a0 = args[0] if args else None
+
+        # transform constructors
+        if name in _XFORM_KINDS:
+            if args_unknown:
+                return UNKNOWN
+            kind = _XFORM_KINDS[name]
+            if not args:
+                return FnVal(builtin=name, bound_kwargs=dict(kwargs),
+                             kw_unknown=kw_splat)
+            meta = dict(kwargs)
+            if kind == "shard_map":
+                for i, key in enumerate(("mesh", "in_specs", "out_specs")):
+                    if len(args) > i + 1:
+                        meta.setdefault(key, args[i + 1])
+            elif kind in ("vmap", "pmap"):
+                for i, key in enumerate(("in_axes", "out_axes")):
+                    if len(args) > i + 1:
+                        meta.setdefault(key, args[i + 1])
+            return XformVal(kind, args[0], meta)
+
+        # meshes, specs, shardings
+        if name in MESH_CALLEES:
+            return self._make_mesh(frame, name, args, kwargs)
+        if name in PARTITION_SPEC_CALLEES:
+            entries: List[object] = []
+            for arg in args:
+                if isinstance(arg, Const) and (
+                        arg.value is None or isinstance(arg.value, str)):
+                    entries.append(arg.value)
+                elif isinstance(arg, TupVal) and all(
+                        isinstance(i, Const) and isinstance(i.value, str)
+                        for i in arg.items):
+                    entries.append(tuple(i.value for i in arg.items))
+                else:
+                    entries.append(DYN)
+            return SpecVal(tuple(entries))
+        if name in _NAMED_SHARDING_CALLEES:
+            mesh = a0 if isinstance(a0, MeshVal) else kwargs.get("mesh")
+            spec = args[1] if len(args) > 1 else kwargs.get("spec")
+            return ShardingVal(mesh if isinstance(mesh, MeshVal) else None,
+                               spec if isinstance(spec, SpecVal) else None)
+
+        # jax top-level
+        if name in ("jax.device_put", "jax.experimental.multihost_utils."
+                    "host_local_array_to_global_array"):
+            sharding = args[1] if len(args) > 1 else kwargs.get("device")
+            if sharding is None:
+                return a0 if a0 is not None else UNKNOWN
+            if isinstance(a0, TupVal):
+                return TupVal(tuple(
+                    self._check_sharding(frame, v, sharding, ln, "device_put")
+                    for v in a0.items))
+            return self._check_sharding(frame, a0, sharding, ln, "device_put")
+        if name in ("jax.device_get", "jax.block_until_ready"):
+            return a0 if a0 is not None else UNKNOWN
+        if name in ("jax.devices", "jax.local_devices"):
+            return Arr((DYN,))
+        if name in ("jax.device_count", "jax.local_device_count",
+                    "jax.process_index", "jax.process_count"):
+            return UNKNOWN
+        if name == "jax.eval_shape":
+            if a0 is not None and not args_unknown:
+                inner = self._traced(frame)
+                return self._call_value(inner, a0, args[1:], kwargs, ln)
+            return UNKNOWN
+        if name in ("jax.ShapeDtypeStruct", "jax.core.ShapedArray"):
+            dims = self._dims_of(a0 if a0 is not None else
+                                 kwargs.get("shape"))
+            dt = self._dtype_of(args[1] if len(args) > 1 else
+                                kwargs.get("dtype"))
+            return Arr(dims, dt)
+        if name in ("jax.tree.map", "jax.tree_util.tree_map",
+                    "jax.tree_map"):
+            return UNKNOWN
+        if name in ("jax.debug.print", "jax.debug.callback"):
+            return Const(None)
+
+        # jax.lax control flow and collectives
+        out = self._call_lax(frame, name, args, kwargs, ln, args_unknown)
+        if out is not NotImplemented:
+            return out
+
+        # jax.random
+        if name.startswith("jax.random."):
+            return self._call_random(frame, name[len("jax.random."):],
+                                     args, kwargs, ln)
+
+        # jax.nn
+        if name.startswith("jax.nn."):
+            short = name[len("jax.nn."):]
+            if short in _NN_UNARY:
+                if isinstance(a0, Arr):
+                    dt = a0.dtype
+                    if dt is not None and not (
+                            dt.startswith("float") or dt.startswith("bfloat")):
+                        dt = "float32"
+                    return Arr(a0.dims, dt, a0.spec, a0.chain)
+                return UNKNOWN
+            if short == "one_hot":
+                n = _known_int(args[1] if len(args) > 1 else
+                               kwargs.get("num_classes"))
+                if isinstance(a0, Arr) and a0.dims is not None:
+                    dims = a0.dims + ((n,) if n is not None else (DYN,))
+                    return Arr(dims, "float32",
+                               chain=extend_chain(a0.chain, ln,
+                                                  f"one_hot -> {fmt_dims(dims)}"))
+                return UNKNOWN
+            return UNKNOWN
+
+        # flax layers (constructed then applied)
+        if name in ("flax.linen.Dense", "nn.Dense"):
+            meta = dict(kwargs)
+            if args:
+                meta.setdefault("features", args[0])
+            return LayerVal("dense", meta)
+        if name in ("flax.linen.Conv", "nn.Conv"):
+            meta = dict(kwargs)
+            for i, key in enumerate(("features", "kernel_size")):
+                if len(args) > i:
+                    meta.setdefault(key, args[i])
+            return LayerVal("conv", meta)
+        if name in ("flax.linen.Dropout", "nn.Dropout"):
+            return LayerVal("dropout", dict(kwargs))
+        if name in ("flax.linen.BatchNorm", "flax.linen.LayerNorm",
+                    "flax.linen.GroupNorm", "flax.linen.RMSNorm",
+                    "nn.BatchNorm", "nn.LayerNorm"):
+            return LayerVal("norm", dict(kwargs))
+        if name in ("flax.linen.max_pool", "flax.linen.avg_pool",
+                    "nn.max_pool", "nn.avg_pool"):
+            return self._pool(frame, args, kwargs, ln)
+
+        # functools / math / python builtins
+        if name == "functools.partial":
+            return self._make_partial(args, kwargs, kw_splat)
+        if name == "functools.reduce":
+            return UNKNOWN
+        if name.startswith("math."):
+            return self._call_math(name[len("math."):], args)
+        out = self._call_py_builtin(frame, name, args, kwargs, ln)
+        if out is not NotImplemented:
+            return out
+
+        # the jnp / np vocabulary
+        if name.startswith("jax.numpy."):
+            return self._call_jnp(frame, name[len("jax.numpy."):], args,
+                                  kwargs, ln, numpy=False)
+        if name.startswith("numpy."):
+            return self._call_jnp(frame, name[len("numpy."):], args,
+                                  kwargs, ln, numpy=True)
+        if name.startswith(("jax.", "scipy.", "flax.")):
+            return UNKNOWN
+        return NotImplemented
+
+    def _make_partial(self, args: List[object], kwargs: Dict[str, object],
+                      kw_splat: bool) -> object:
+        if not args:
+            return UNKNOWN
+        target = args[0]
+        rest = tuple(args[1:])
+        if isinstance(target, ModRef):
+            target = FnVal(builtin=target.name)
+        if isinstance(target, FnVal):
+            merged_kw = dict(target.bound_kwargs or {})
+            merged_kw.update(kwargs)
+            return FnVal(
+                module=target.module, node=target.node,
+                closure=target.closure, builtin=target.builtin,
+                bound_args=target.bound_args + rest,
+                bound_kwargs=merged_kw,
+                kw_unknown=target.kw_unknown or kw_splat,
+            )
+        if isinstance(target, XformVal):
+            return UNKNOWN
+        return UNKNOWN
+
+    def _call_math(self, short: str, args: List[object]) -> object:
+        import math as _math
+        a0 = args[0] if args else None
+        if short == "prod":
+            dims = self._dims_of(a0)
+            if dims is not None and all(isinstance(d, int) for d in dims):
+                n = 1
+                for d in dims:
+                    n *= d
+                return Const(n)
+            return UNKNOWN
+        if isinstance(a0, Const) and isinstance(a0.value, (int, float)):
+            fn = getattr(_math, short, None)
+            if fn is not None:
+                try:
+                    return Const(fn(*[
+                        a.value for a in args if isinstance(a, Const)]))
+                except Exception:
+                    return UNKNOWN
+        return UNKNOWN
+
+    def _call_py_builtin(self, frame: _Frame, name: str, args: List[object],
+                         kwargs: Dict[str, object], ln: int) -> object:
+        a0 = args[0] if args else None
+        if name == "len":
+            if isinstance(a0, TupVal):
+                return Const(len(a0.items))
+            if isinstance(a0, Const) and isinstance(a0.value, (str, tuple)):
+                return Const(len(a0.value))
+            if isinstance(a0, Arr) and a0.dims:
+                return _dim_to_val(a0.dims[0]) if not isinstance(
+                    a0.dims[0], Sym) else a0.dims[0]
+            return UNKNOWN
+        if name in ("tuple", "list"):
+            if isinstance(a0, TupVal):
+                return a0
+            if isinstance(a0, Const) and isinstance(a0.value, tuple):
+                return TupVal(tuple(Const(v) for v in a0.value))
+            if a0 is None:
+                return TupVal(())
+            return UNKNOWN
+        if name in ("int", "float", "bool", "abs", "round"):
+            if isinstance(a0, Const) and isinstance(a0.value, (int, float,
+                                                               bool, str)):
+                try:
+                    return Const({"int": int, "float": float, "bool": bool,
+                                  "abs": abs, "round": round}[name](a0.value))
+                except Exception:
+                    return UNKNOWN
+            if name == "abs" and isinstance(a0, Arr):
+                return a0
+            return UNKNOWN
+        if name in ("min", "max", "sum"):
+            vals = args if len(args) > 1 else (
+                list(a0.items) if isinstance(a0, TupVal) else None)
+            if vals and all(isinstance(v, Const) and
+                            isinstance(v.value, (int, float))
+                            for v in vals):
+                try:
+                    return Const({"min": min, "max": max, "sum": sum}[name](
+                        [v.value for v in vals]))
+                except Exception:
+                    return UNKNOWN
+            return UNKNOWN
+        if name == "getattr":
+            if isinstance(a0, ModRef) and len(args) > 1 and \
+                    isinstance(args[1], Const) and \
+                    isinstance(args[1].value, str):
+                return self._resolve_dotted(frame,
+                                            f"{a0.name}.{args[1].value}")
+            return UNKNOWN
+        if name in ("isinstance", "hasattr", "callable", "issubclass"):
+            return UNKNOWN
+        if name == "print":
+            return Const(None)
+        if name in ("range", "enumerate", "zip", "map", "filter", "sorted",
+                    "reversed", "dict", "set", "frozenset", "iter", "next",
+                    "vars", "repr", "str", "format", "id", "hash", "type",
+                    "divmod", "any", "all"):
+            return UNKNOWN
+        return NotImplemented
+
+    def _make_mesh(self, frame: _Frame, name: str, args: List[object],
+                   kwargs: Dict[str, object]) -> object:
+        is_make = name.endswith("make_mesh")
+        axes_val = args[1] if len(args) > 1 else kwargs.get(
+            "axis_names", kwargs.get("axis_name"))
+        axes: List[str] = []
+        if isinstance(axes_val, TupVal):
+            for item in axes_val.items:
+                if isinstance(item, Const) and isinstance(item.value, str):
+                    axes.append(item.value)
+                else:
+                    return UNKNOWN
+        elif isinstance(axes_val, Const) and isinstance(axes_val.value, str):
+            axes = [axes_val.value]
+        elif isinstance(axes_val, Const) and isinstance(axes_val.value, tuple) \
+                and all(isinstance(v, str) for v in axes_val.value):
+            axes = list(axes_val.value)
+        else:
+            return UNKNOWN
+        sizes: List[object] = [DYN] * len(axes)
+        first = args[0] if args else kwargs.get(
+            "axis_shapes" if is_make else "devices")
+        if is_make:
+            dims = self._dims_of(first)
+            if dims is not None:
+                for i in range(min(len(axes), len(dims))):
+                    sizes[i] = dims[i] if isinstance(dims[i], int) else DYN
+        elif isinstance(first, Arr) and first.dims is not None:
+            for i in range(min(len(axes), len(first.dims))):
+                d = first.dims[i]
+                sizes[i] = d if isinstance(d, int) else DYN
+        return MeshVal(tuple(axes), tuple(sizes))
+
+    def _pool(self, frame: _Frame, args: List[object],
+              kwargs: Dict[str, object], ln: int) -> object:
+        x = args[0] if args else kwargs.get("inputs")
+        if not isinstance(x, Arr):
+            return UNKNOWN
+        meta = {
+            "features": None,
+            "kernel_size": args[1] if len(args) > 1 else
+            kwargs.get("window_shape"),
+            "strides": args[2] if len(args) > 2 else kwargs.get("strides"),
+            "padding": kwargs.get("padding", Const("VALID")),
+        }
+        out = self._conv_shape(frame, x, meta, ln)
+        if isinstance(out, Arr) and out.dims is not None and x.dims:
+            # pools keep the channel dim instead of projecting to features
+            dims = out.dims[:-1] + (x.dims[-1],)
+            return Arr(dims, x.dtype,
+                       chain=extend_chain(x.chain, ln,
+                                          f"pool -> {fmt_dims(dims)}"))
+        return out
+
+    def _call_random(self, frame: _Frame, short: str, args: List[object],
+                     kwargs: Dict[str, object], ln: int) -> object:
+        a0 = args[0] if args else None
+        if short in ("PRNGKey", "key"):
+            return Arr((2,), "uint32")
+        if short == "split":
+            n = _known_int(args[1] if len(args) > 1 else
+                           kwargs.get("num", Const(2)))
+            return Arr((n if n is not None else DYN, 2), "uint32")
+        if short == "fold_in":
+            return a0 if isinstance(a0, Arr) else Arr((2,), "uint32")
+        if short in ("normal", "uniform", "truncated_normal", "gumbel",
+                     "exponential", "laplace", "cauchy", "beta", "gamma",
+                     "dirichlet"):
+            shape = args[1] if len(args) > 1 else kwargs.get("shape")
+            dims = self._dims_of(shape) if shape is not None else ()
+            dt = self._dtype_of(kwargs.get("dtype")) or "float32"
+            return Arr(dims, dt)
+        if short in ("randint", "poisson", "categorical_onehot"):
+            shape = kwargs.get("shape")
+            dims = self._dims_of(shape) if shape is not None else None
+            return Arr(dims, "int32")
+        if short == "bernoulli":
+            shape = args[2] if len(args) > 2 else kwargs.get("shape")
+            if shape is not None:
+                return Arr(self._dims_of(shape), "bool")
+            p = args[1] if len(args) > 1 else kwargs.get("p")
+            if isinstance(p, Arr):
+                return Arr(p.dims, "bool")
+            return Arr((), "bool")
+        if short == "categorical":
+            logits = args[1] if len(args) > 1 else kwargs.get("logits")
+            axis = kwargs.get("axis", Const(-1))
+            if isinstance(logits, Arr) and logits.dims is not None:
+                k = _known_int(axis)
+                if k is not None and -len(logits.dims) <= k < len(logits.dims):
+                    k %= len(logits.dims)
+                    return Arr(logits.dims[:k] + logits.dims[k + 1:],
+                               "int32")
+            return Arr(None, "int32")
+        if short in ("permutation", "shuffle", "choice"):
+            x = args[1] if len(args) > 1 else None
+            if isinstance(x, Arr):
+                return Arr(x.dims, x.dtype)
+            k = _known_int(x)
+            if k is not None:
+                return Arr((k,), "int32")
+            return UNKNOWN
+        return UNKNOWN
+
+    def _call_lax(self, frame: _Frame, name: str, args: List[object],
+                  kwargs: Dict[str, object], ln: int,
+                  args_unknown: bool) -> object:
+        if not name.startswith("jax.lax."):
+            return NotImplemented
+        short = name[len("jax.lax."):]
+        a0 = args[0] if args else None
+
+        if short in ("psum", "pmean", "pmax", "pmin", "psum_scatter",
+                     "ppermute", "pshuffle", "pvary", "pcast",
+                     "stop_gradient"):
+            if isinstance(a0, TupVal):
+                return a0
+            return a0 if a0 is not None else UNKNOWN
+        if short == "axis_index":
+            return Arr((), "int32")
+        if short == "axis_size":
+            n = self._axis_size(frame, a0)
+            return Const(n) if isinstance(n, int) else UNKNOWN
+        if short == "all_gather":
+            if not isinstance(a0, Arr):
+                return UNKNOWN
+            n = self._axis_size(frame, args[1] if len(args) > 1 else
+                                kwargs.get("axis_name"))
+            axis = _known_int(kwargs.get("axis", Const(0))) or 0
+            tiled = kwargs.get("tiled")
+            if a0.dims is None:
+                return Arr(None, a0.dtype)
+            rank = len(a0.dims)
+            if isinstance(tiled, Const) and tiled.value:
+                if 0 <= axis < rank:
+                    d = a0.dims[axis]
+                    newd = d * n if isinstance(d, int) and \
+                        isinstance(n, int) else DYN
+                    dims = a0.dims[:axis] + (newd,) + a0.dims[axis + 1:]
+                else:
+                    dims = None
+            else:
+                axis = max(0, min(axis, rank))
+                dims = a0.dims[:axis] + \
+                    (n if isinstance(n, int) else DYN,) + a0.dims[axis:]
+            return Arr(dims, a0.dtype,
+                       chain=extend_chain(a0.chain, ln,
+                                          f"all_gather -> {fmt_dims(dims)}"))
+        if short == "all_to_all":
+            if not isinstance(a0, Arr):
+                return UNKNOWN
+            n = self._axis_size(frame, args[1] if len(args) > 1 else
+                                kwargs.get("axis_name"))
+            split = _known_int(args[2] if len(args) > 2 else
+                               kwargs.get("split_axis"))
+            concat = _known_int(args[3] if len(args) > 3 else
+                                kwargs.get("concat_axis"))
+            tiled = kwargs.get("tiled")
+            is_tiled = isinstance(tiled, Const) and bool(tiled.value)
+            if a0.dims is None or split is None or concat is None:
+                return Arr(None, a0.dtype)
+            if not is_tiled:
+                return Arr(None, a0.dtype)
+            dims = list(a0.dims)
+            rank = len(dims)
+            if not (0 <= split < rank and 0 <= concat < rank):
+                return Arr(None, a0.dtype)
+            d = dims[split]
+            if isinstance(n, int):
+                if isinstance(d, int):
+                    if n > 0 and d % n != 0:
+                        self._emit(
+                            "indivisible-sharding", frame, ln,
+                            f"all_to_all(tiled=True) splits dim {split} of "
+                            f"{fmt_arr(a0)} across an axis of size {n}, "
+                            f"but {d} % {n} != 0",
+                            a0.chain,
+                        )
+                        dims[split] = DYN
+                    else:
+                        dims[split] = d // n
+                else:
+                    dims[split] = DYN
+                c = dims[concat]
+                dims[concat] = c * n if isinstance(c, int) else DYN
+            else:
+                dims[split] = DYN
+                dims[concat] = DYN
+            new = tuple(dims)
+            return Arr(new, a0.dtype,
+                       chain=extend_chain(a0.chain, ln,
+                                          f"all_to_all -> {fmt_dims(new)}"))
+        if short == "with_sharding_constraint":
+            sharding = args[1] if len(args) > 1 else kwargs.get("shardings")
+            if sharding is None:
+                return a0 if a0 is not None else UNKNOWN
+            return self._check_sharding(frame, a0, sharding, ln,
+                                        "with_sharding_constraint")
+        if short in ("select", "select_n"):
+            for cand in args[1:]:
+                if isinstance(cand, Arr):
+                    return cand
+            return UNKNOWN
+        if short == "dynamic_slice":
+            sizes = args[-1] if args else None
+            dims = self._dims_of(sizes)
+            dt = a0.dtype if isinstance(a0, Arr) else None
+            return Arr(dims, dt)
+        if short in ("dynamic_update_slice", "dynamic_update_slice_in_dim"):
+            return a0 if isinstance(a0, Arr) else UNKNOWN
+        if short == "iota":
+            dt = self._dtype_of(a0)
+            n = _known_int(args[1] if len(args) > 1 else kwargs.get("size"))
+            return Arr((n if n is not None else DYN,), dt or "int32")
+        if short == "broadcasted_iota":
+            dt = self._dtype_of(a0)
+            dims = self._dims_of(args[1] if len(args) > 1 else
+                                 kwargs.get("shape"))
+            return Arr(dims, dt or "int32")
+        if short == "top_k":
+            k = _known_int(args[1] if len(args) > 1 else kwargs.get("k"))
+            if isinstance(a0, Arr) and a0.dims is not None:
+                dims = a0.dims[:-1] + (k if k is not None else DYN,)
+                return TupVal((Arr(dims, a0.dtype, chain=a0.chain),
+                               Arr(dims, "int32")))
+            return TupVal((Arr(None), Arr(None, "int32")))
+        if short == "convert_element_type":
+            dt = self._dtype_of(args[1] if len(args) > 1 else
+                                kwargs.get("new_dtype"))
+            if isinstance(a0, Arr) and dt:
+                return self._cast(frame, a0, dt, ln)
+            return a0 if isinstance(a0, Arr) else UNKNOWN
+        if short in ("exp", "log", "sqrt", "rsqrt", "tanh", "erf", "abs",
+                     "neg", "sign", "floor", "ceil", "round", "logistic"):
+            return a0 if isinstance(a0, Arr) else UNKNOWN
+        if short in ("add", "sub", "mul", "div", "max", "min", "pow",
+                     "rem", "atan2"):
+            if len(args) >= 2:
+                return self._broadcast_op(frame, args[0], args[1], ln,
+                                          f"lax.{short}")
+            return UNKNOWN
+
+        if short == "fori_loop":
+            if len(args) < 4 or args_unknown:
+                return UNKNOWN
+            body, init = args[2], args[3]
+            out = self._call_value(self._traced(frame), body,
+                                   [Arr((), "int32"), init], {}, ln)
+            self._carry_check(frame, init, out, ln, "fori_loop")
+            return self._join(init, out)
+        if short == "while_loop":
+            if len(args) < 3 or args_unknown:
+                return UNKNOWN
+            cond, body, init = args[0], args[1], args[2]
+            self._call_value(self._traced(frame), cond, [init], {}, ln)
+            out = self._call_value(self._traced(frame), body, [init], {}, ln)
+            self._carry_check(frame, init, out, ln, "while_loop")
+            return self._join(init, out)
+        if short == "scan":
+            if len(args) < 2 or args_unknown:
+                return UNKNOWN
+            f, init = args[0], args[1]
+            xs = args[2] if len(args) > 2 else kwargs.get("xs")
+            lead: object = DYN
+            if isinstance(xs, Arr) and xs.dims:
+                elem: object = Arr(xs.dims[1:], xs.dtype)
+                lead = xs.dims[0]
+            elif isinstance(xs, Arr):
+                elem = Arr(None, xs.dtype)
+            else:
+                n = _known_int(kwargs.get("length") or
+                               (args[3] if len(args) > 3 else None))
+                lead = n if n is not None else DYN
+                elem = UNKNOWN
+            out = self._call_value(self._traced(frame), f, [init, elem],
+                                   {}, ln)
+            if isinstance(out, TupVal) and len(out.items) == 2:
+                carry, y = out.items
+            else:
+                carry, y = out, UNKNOWN
+            self._carry_check(frame, init, carry, ln, "scan")
+            if isinstance(y, Arr) and y.dims is not None:
+                ys: object = Arr((lead,) + y.dims, y.dtype)
+            elif isinstance(y, Arr):
+                ys = Arr(None, y.dtype)
+            else:
+                ys = UNKNOWN
+            return TupVal((self._join(init, carry), ys))
+        if short == "cond":
+            if len(args) < 3 or args_unknown:
+                return UNKNOWN
+            operands = args[3:]
+            t = self._call_value(self._traced(frame), args[1], list(operands),
+                                 {}, ln)
+            f = self._call_value(self._traced(frame), args[2], list(operands),
+                                 {}, ln)
+            return self._join(t, f)
+        if short == "switch":
+            if len(args) < 2 or args_unknown:
+                return UNKNOWN
+            branches = args[1]
+            operands = args[2:]
+            if isinstance(branches, TupVal) and branches.items:
+                out = self._call_value(self._traced(frame), branches.items[0],
+                                       list(operands), {}, ln)
+                for b in branches.items[1:]:
+                    out = self._join(out, self._call_value(
+                        self._traced(frame), b, list(operands), {}, ln))
+                return out
+            return UNKNOWN
+        if short == "map":
+            return UNKNOWN
+        if short in ("full", "full_like", "zeros_like", "ones_like"):
+            return self._call_jnp(frame, short, args, kwargs, ln,
+                                  numpy=False)
+        return UNKNOWN
+
+    def _call_jnp(self, frame: _Frame, short: str, args: List[object],
+                  kwargs: Dict[str, object], ln: int, numpy: bool) -> object:
+        a0 = args[0] if args else None
+        default_float = "float64" if numpy else "float32"
+        default_int = "int64" if numpy else "int32"
+
+        # creation
+        if short in ("zeros", "ones", "empty", "full"):
+            shape = a0 if a0 is not None else kwargs.get("shape")
+            dims = self._dims_of(shape)
+            dt_pos = 2 if short == "full" else 1
+            dt = self._dtype_of(args[dt_pos] if len(args) > dt_pos else
+                                kwargs.get("dtype")) or default_float
+            out = Arr(dims, dt)
+            out.chain = extend_chain((), ln, f"jnp.{short} -> {fmt_arr(out)}")
+            return out
+        if short in ("zeros_like", "ones_like", "empty_like", "full_like"):
+            dt = self._dtype_of(kwargs.get("dtype"))
+            if isinstance(a0, Arr):
+                return Arr(a0.dims, dt or a0.dtype, chain=a0.chain)
+            return Arr(None, dt)
+        if short in ("asarray", "array"):
+            dt = self._dtype_of(args[1] if len(args) > 1 else
+                                kwargs.get("dtype"))
+            if isinstance(a0, Arr):
+                return Arr(a0.dims, dt or a0.dtype, a0.spec, a0.chain)
+            if isinstance(a0, Const) and isinstance(a0.value,
+                                                    (int, float, bool)):
+                return Arr((), dt)
+            if isinstance(a0, TupVal):
+                return Arr((len(a0.items),), dt)
+            return Arr(None, dt)
+        if short == "arange":
+            dt = self._dtype_of(kwargs.get("dtype"))
+            ints = [_known_int(a) for a in args[:3]]
+            if len(args) == 1 and ints[0] is not None:
+                n: object = ints[0]
+            elif len(args) >= 2 and ints[0] is not None and \
+                    ints[1] is not None:
+                step = ints[2] if len(args) > 2 and ints[2] else 1
+                try:
+                    n = max(0, -(-(ints[1] - ints[0]) // step))
+                except Exception:
+                    n = DYN
+            else:
+                n = DYN
+            has_float = any(isinstance(a, Const) and
+                            isinstance(a.value, float) for a in args[:3])
+            out = Arr((n,), dt or (default_float if has_float else
+                                   default_int))
+            out.chain = extend_chain((), ln, f"arange -> {fmt_arr(out)}")
+            return out
+        if short == "linspace":
+            n = _known_int(args[2] if len(args) > 2 else
+                           kwargs.get("num", Const(50)))
+            dt = self._dtype_of(kwargs.get("dtype")) or default_float
+            out = Arr((n if n is not None else DYN,), dt)
+            out.chain = extend_chain(
+                (), ln, f"{'np' if numpy else 'jnp'}.linspace -> {fmt_arr(out)}")
+            return out
+        if short in ("eye", "identity"):
+            n = _known_int(a0)
+            m = _known_int(args[1]) if len(args) > 1 else n
+            dt = self._dtype_of(kwargs.get("dtype")) or default_float
+            return Arr((n if n is not None else DYN,
+                        m if m is not None else DYN), dt)
+
+        # manipulation
+        if short == "reshape":
+            return self._reshape(frame, args, kwargs, ln)
+        if short == "ravel":
+            if isinstance(a0, Arr):
+                if a0.dims is not None and all(
+                        isinstance(d, int) for d in a0.dims):
+                    n = 1
+                    for d in a0.dims:
+                        n *= d
+                    return Arr((n,), a0.dtype, chain=a0.chain)
+                return Arr((DYN,), a0.dtype, chain=a0.chain)
+            return UNKNOWN
+        if short == "transpose":
+            if not isinstance(a0, Arr):
+                return UNKNOWN
+            if a0.dims is None:
+                return Arr(None, a0.dtype)
+            perm = self._dims_of(args[1] if len(args) > 1 else
+                                 kwargs.get("axes"))
+            if perm is None:
+                dims = tuple(reversed(a0.dims))
+            elif all(isinstance(p, int) and -len(a0.dims) <= p <
+                     len(a0.dims) for p in perm) and len(perm) == len(a0.dims):
+                dims = tuple(a0.dims[p % len(a0.dims)] for p in perm)
+            else:
+                return Arr(None, a0.dtype)
+            return Arr(dims, a0.dtype,
+                       chain=extend_chain(a0.chain, ln,
+                                          f"transpose -> {fmt_dims(dims)}"))
+        if short in ("swapaxes", "moveaxis"):
+            if not isinstance(a0, Arr) or a0.dims is None:
+                return Arr(None, a0.dtype) if isinstance(a0, Arr) else UNKNOWN
+            i = _known_int(args[1] if len(args) > 1 else None)
+            j = _known_int(args[2] if len(args) > 2 else None)
+            rank = len(a0.dims)
+            if i is None or j is None or not (-rank <= i < rank) or \
+                    not (-rank <= j < rank):
+                return Arr(None, a0.dtype)
+            dims = list(a0.dims)
+            if short == "swapaxes":
+                dims[i % rank], dims[j % rank] = dims[j % rank], dims[i % rank]
+            else:
+                d = dims.pop(i % rank)
+                dims.insert(j % rank, d)
+            return Arr(tuple(dims), a0.dtype, chain=a0.chain)
+        if short == "expand_dims":
+            if not isinstance(a0, Arr) or a0.dims is None:
+                return Arr(None, a0.dtype) if isinstance(a0, Arr) else UNKNOWN
+            k = _known_int(args[1] if len(args) > 1 else kwargs.get("axis"))
+            if k is None:
+                return Arr(None, a0.dtype)
+            rank = len(a0.dims)
+            if k < 0:
+                k = rank + 1 + k
+            k = max(0, min(k, rank))
+            dims = a0.dims[:k] + (1,) + a0.dims[k:]
+            return Arr(dims, a0.dtype, chain=a0.chain)
+        if short == "squeeze":
+            if not isinstance(a0, Arr) or a0.dims is None:
+                return Arr(None, a0.dtype) if isinstance(a0, Arr) else UNKNOWN
+            axis = self._axis_arg(args, kwargs)
+            if axis is None:
+                if all(isinstance(d, int) for d in a0.dims):
+                    return Arr(tuple(d for d in a0.dims if d != 1),
+                               a0.dtype, chain=a0.chain)
+                return Arr(None, a0.dtype)
+            axes = self._dims_of(axis)
+            if axes is None or not all(isinstance(x, int) for x in axes):
+                return Arr(None, a0.dtype)
+            rank = len(a0.dims)
+            drop = {x % rank for x in axes if -rank <= x < rank}
+            return Arr(tuple(d for i, d in enumerate(a0.dims)
+                             if i not in drop), a0.dtype, chain=a0.chain)
+        if short == "broadcast_to":
+            target = self._dims_of(args[1] if len(args) > 1 else
+                                   kwargs.get("shape"))
+            if not isinstance(a0, Arr):
+                return Arr(target) if target is not None else UNKNOWN
+            if target is None:
+                return Arr(None, a0.dtype)
+            if a0.dims is not None:
+                src = list(a0.dims)
+                if len(src) > len(target):
+                    self._emit(
+                        "shape-mismatch", frame, ln,
+                        f"broadcast_to target rank {len(target)} is lower "
+                        f"than input {fmt_arr(a0)}",
+                        a0.chain,
+                    )
+                else:
+                    for ds, dt_ in zip(reversed(src), reversed(target)):
+                        if isinstance(ds, int) and isinstance(dt_, int) and \
+                                ds != 1 and ds != dt_:
+                            self._emit(
+                                "shape-mismatch", frame, ln,
+                                f"cannot broadcast {fmt_arr(a0)} to "
+                                f"{fmt_dims(tuple(target))}: dim {ds} vs "
+                                f"{dt_}",
+                                a0.chain,
+                            )
+                            break
+            return Arr(tuple(target), a0.dtype,
+                       chain=extend_chain(a0.chain, ln,
+                                          f"broadcast_to {fmt_dims(tuple(target))}"))
+        if short in ("concatenate", "concat"):
+            return self._concat(frame, args, kwargs, ln)
+        if short in ("stack", "vstack", "hstack", "dstack", "column_stack"):
+            if short != "stack":
+                return UNKNOWN
+            return self._stack(frame, args, kwargs, ln)
+        if short == "pad":
+            return self._pad(frame, args, kwargs, ln)
+        if short == "where":
+            if len(args) < 3:
+                return UNKNOWN
+            xy = self._broadcast_op(frame, args[1], args[2], ln, "where")
+            if isinstance(xy, Arr) and isinstance(args[0], Arr):
+                dims = self._broadcast_dims(frame, args[0].dims, xy.dims, ln,
+                                            "where", xy.chain,
+                                            args[0], xy)
+                return Arr(dims, xy.dtype, chain=xy.chain)
+            return xy
+        if short == "repeat":
+            if not isinstance(a0, Arr) or a0.dims is None:
+                return Arr(None, a0.dtype) if isinstance(a0, Arr) else UNKNOWN
+            reps = _known_int(args[1] if len(args) > 1 else
+                              kwargs.get("repeats"))
+            axis = _known_int(self._axis_arg(args, kwargs, pos=2))
+            if axis is None:
+                total = DYN
+                if reps is not None and all(
+                        isinstance(d, int) for d in a0.dims):
+                    total = reps
+                    for d in a0.dims:
+                        total *= d
+                return Arr((total,), a0.dtype, chain=a0.chain)
+            rank = len(a0.dims)
+            if not (-rank <= axis < rank):
+                return Arr(None, a0.dtype)
+            axis %= rank
+            d = a0.dims[axis]
+            newd = d * reps if isinstance(d, int) and reps is not None else DYN
+            return Arr(a0.dims[:axis] + (newd,) + a0.dims[axis + 1:],
+                       a0.dtype, chain=a0.chain)
+        if short == "tile":
+            return Arr(None, a0.dtype) if isinstance(a0, Arr) else UNKNOWN
+        if short in ("split", "array_split", "unstack", "meshgrid",
+                     "unique", "nonzero", "ix_", "indices", "histogram"):
+            return UNKNOWN
+        if short in ("take", "take_along_axis", "searchsorted", "digitize",
+                     "interp", "bincount"):
+            return UNKNOWN
+
+        # contraction
+        if short == "einsum":
+            return self._einsum(frame, args, kwargs, ln)
+        if short in ("matmul", "dot", "tensordot", "inner", "outer", "vdot"):
+            if short in ("matmul", "dot") and len(args) >= 2:
+                return self._matmul(frame, args[0], args[1], ln, kwargs)
+            return UNKNOWN
+
+        # elementwise / reductions
+        if short in _BINARY_BROADCAST and len(args) >= 2:
+            return self._broadcast_op(frame, args[0], args[1], ln, short)
+        if short in _BINARY_BOOL and len(args) >= 2:
+            return self._broadcast_op(frame, args[0], args[1], ln, short,
+                                      bool_result=True)
+        if short in _UNARY_BOOL:
+            if isinstance(a0, Arr):
+                return Arr(a0.dims, "bool", chain=a0.chain)
+            return UNKNOWN
+        if short in _UNARY_ELEMENTWISE:
+            if isinstance(a0, Arr):
+                dt = a0.dtype
+                if short in _UNARY_FLOATING and dt is not None and not (
+                        dt.startswith("float") or dt.startswith("bfloat") or
+                        dt.startswith("complex")):
+                    dt = default_float
+                out = Arr(a0.dims, dt, a0.spec, a0.chain)
+                self._check_promotion(frame, ln, out, (a0.dtype,), short)
+                return out
+            if isinstance(a0, Const) and isinstance(a0.value, (int, float)):
+                import math as _math
+                pyfn = {"sqrt": _math.sqrt, "exp": _math.exp,
+                        "log": _math.log, "abs": abs,
+                        "floor": _math.floor, "ceil": _math.ceil}.get(short)
+                if pyfn is not None:
+                    try:
+                        return Const(pyfn(a0.value))
+                    except Exception:
+                        return UNKNOWN
+                return UNKNOWN
+            return UNKNOWN
+        if short in _REDUCTIONS:
+            if not isinstance(a0, Arr):
+                return UNKNOWN
+            dims = self._reduce_dims(a0, self._axis_arg(args, kwargs),
+                                     kwargs.get("keepdims"))
+            if short in _REDUCTION_INT_RESULT:
+                dt: Optional[str] = default_int
+            elif short in _REDUCTION_BOOL_RESULT:
+                dt = "bool"
+            elif short in ("mean", "std", "var", "nanmean", "nanstd",
+                           "nanvar", "median", "nanmedian") and \
+                    a0.dtype is not None and not (
+                        a0.dtype.startswith("float") or
+                        a0.dtype.startswith("bfloat")):
+                dt = default_float
+            else:
+                dt = a0.dtype
+            return Arr(dims, dt,
+                       chain=extend_chain(a0.chain, ln,
+                                          f"{short} -> {fmt_dims(dims)}"))
+        if short in _SAME_SHAPE:
+            if isinstance(a0, Arr):
+                dt = default_int if short == "argsort" else a0.dtype
+                return Arr(a0.dims, dt, a0.spec, a0.chain)
+            return UNKNOWN
+        if short == "astype" and len(args) >= 2:
+            dt = self._dtype_of(args[1])
+            if isinstance(a0, Arr) and dt:
+                return self._cast(frame, a0, dt, ln)
+            return UNKNOWN
+        if short in _DTYPE_NAMES:
+            # jnp.float32(x) — cast call on the dtype object
+            if args:
+                return self._cast(frame, a0, short, ln)
+            return DtypeVal(short)
+        return UNKNOWN
+
+    def _reshape(self, frame: _Frame, args: List[object],
+                 kwargs: Dict[str, object], ln: int) -> object:
+        a0 = args[0] if args else None
+        if not isinstance(a0, Arr):
+            return UNKNOWN
+        rest = args[1:]
+        if len(rest) == 1:
+            target = self._dims_of(rest[0])
+            if target is None:
+                target_list = [_val_to_dim(rest[0])]
+            else:
+                target_list = list(target)
+        elif "newshape" in kwargs or "shape" in kwargs:
+            target = self._dims_of(kwargs.get("newshape",
+                                              kwargs.get("shape")))
+            target_list = list(target) if target is not None else [DYN]
+        else:
+            target_list = [_val_to_dim(v) for v in rest]
+        if not target_list:
+            target_list = []
+        neg = [i for i, d in enumerate(target_list)
+               if isinstance(d, int) and d == -1]
+        known_new = [d for d in target_list if isinstance(d, int) and d != -1]
+        all_new_int = all(isinstance(d, int) for d in target_list)
+        orig_n: Optional[int] = None
+        if a0.dims is not None and all(isinstance(d, int) for d in a0.dims):
+            orig_n = 1
+            for d in a0.dims:
+                orig_n *= d
+        if len(neg) == 1 and all(
+                isinstance(d, int) for d in target_list if d != -1):
+            rest_n = 1
+            for d in known_new:
+                rest_n *= d
+            if orig_n is not None:
+                if rest_n == 0 or orig_n % rest_n != 0:
+                    self._emit(
+                        "shape-mismatch", frame, ln,
+                        f"reshape of {fmt_arr(a0)} to "
+                        f"{fmt_dims(tuple(target_list))} does not preserve "
+                        f"the element count ({orig_n} elements)",
+                        a0.chain,
+                    )
+                    target_list[neg[0]] = DYN
+                else:
+                    target_list[neg[0]] = orig_n // rest_n
+            else:
+                target_list[neg[0]] = DYN
+        elif not neg and all_new_int and orig_n is not None:
+            new_n = 1
+            for d in target_list:
+                new_n *= d
+            if new_n != orig_n:
+                self._emit(
+                    "shape-mismatch", frame, ln,
+                    f"reshape of {fmt_arr(a0)} to "
+                    f"{fmt_dims(tuple(target_list))} changes the element "
+                    f"count ({orig_n} -> {new_n})",
+                    a0.chain,
+                )
+        elif neg:
+            for i in neg:
+                target_list[i] = DYN
+        dims = tuple(target_list)
+        return Arr(dims, a0.dtype, None,
+                   extend_chain(a0.chain, ln, f"reshape -> {fmt_dims(dims)}"))
+
+    def _concat(self, frame: _Frame, args: List[object],
+                kwargs: Dict[str, object], ln: int) -> object:
+        seq = args[0] if args else None
+        if not isinstance(seq, TupVal) or not seq.items:
+            return UNKNOWN
+        axis = _known_int(self._axis_arg(args, kwargs)) or 0
+        arrs = [v for v in seq.items if isinstance(v, Arr)]
+        if len(arrs) != len(seq.items):
+            return UNKNOWN
+        if any(a.dims is None for a in arrs):
+            return Arr(None, arrs[0].dtype)
+        rank = len(arrs[0].dims)
+        if any(len(a.dims) != rank for a in arrs) or not (
+                -rank <= axis < rank):
+            self._emit(
+                "shape-mismatch", frame, ln,
+                "concatenate operands have different ranks: " +
+                ", ".join(fmt_arr(a) for a in arrs),
+                arrs[0].chain,
+            )
+            return Arr(None, arrs[0].dtype)
+        axis %= rank
+        out_dims: List[object] = []
+        for i in range(rank):
+            ds = [a.dims[i] for a in arrs]
+            if i == axis:
+                if all(isinstance(d, int) for d in ds):
+                    out_dims.append(sum(ds))
+                else:
+                    out_dims.append(DYN)
+                continue
+            ints = [d for d in ds if isinstance(d, int)]
+            if len(set(ints)) > 1:
+                self._emit(
+                    "shape-mismatch", frame, ln,
+                    f"concatenate along axis {axis}: operands disagree on "
+                    f"dim {i}: " + ", ".join(fmt_arr(a) for a in arrs),
+                    arrs[0].chain,
+                )
+                out_dims.append(DYN)
+            elif ints and len(ints) == len(ds):
+                out_dims.append(ints[0])
+            elif len({id(d) if isinstance(d, Sym) else d
+                      for d in ds}) == 1:
+                out_dims.append(ds[0])
+            else:
+                out_dims.append(ints[0] if ints else DYN)
+        dt = arrs[0].dtype
+        for a in arrs[1:]:
+            dt = promote_dtype(dt, a.dtype)
+        dims = tuple(out_dims)
+        return Arr(dims, dt,
+                   chain=extend_chain(arrs[0].chain, ln,
+                                      f"concatenate -> {fmt_dims(dims)}"))
+
+    def _stack(self, frame: _Frame, args: List[object],
+               kwargs: Dict[str, object], ln: int) -> object:
+        seq = args[0] if args else None
+        if not isinstance(seq, TupVal) or not seq.items:
+            return UNKNOWN
+        axis = _known_int(self._axis_arg(args, kwargs)) or 0
+        arrs = [v for v in seq.items if isinstance(v, Arr)]
+        if len(arrs) != len(seq.items):
+            return UNKNOWN
+        if any(a.dims is None for a in arrs):
+            return Arr(None, arrs[0].dtype)
+        rank = len(arrs[0].dims)
+        for a in arrs[1:]:
+            if len(a.dims) != rank:
+                self._emit(
+                    "shape-mismatch", frame, ln,
+                    "stack operands have different ranks: " +
+                    ", ".join(fmt_arr(x) for x in arrs),
+                    arrs[0].chain,
+                )
+                return Arr(None, arrs[0].dtype)
+            for i in range(rank):
+                d0, d1 = arrs[0].dims[i], a.dims[i]
+                if isinstance(d0, int) and isinstance(d1, int) and d0 != d1:
+                    self._emit(
+                        "shape-mismatch", frame, ln,
+                        f"stack operands disagree on dim {i}: "
+                        f"{fmt_arr(arrs[0])} vs {fmt_arr(a)}",
+                        arrs[0].chain,
+                    )
+                    return Arr(None, arrs[0].dtype)
+        if not (-rank - 1 <= axis <= rank):
+            return Arr(None, arrs[0].dtype)
+        if axis < 0:
+            axis = rank + 1 + axis
+        dims = arrs[0].dims[:axis] + (len(arrs),) + arrs[0].dims[axis:]
+        dt = arrs[0].dtype
+        for a in arrs[1:]:
+            dt = promote_dtype(dt, a.dtype)
+        return Arr(dims, dt,
+                   chain=extend_chain(arrs[0].chain, ln,
+                                      f"stack -> {fmt_dims(dims)}"))
+
+    def _pad(self, frame: _Frame, args: List[object],
+             kwargs: Dict[str, object], ln: int) -> object:
+        a0 = args[0] if args else None
+        if not isinstance(a0, Arr):
+            return UNKNOWN
+        if a0.dims is None:
+            return Arr(None, a0.dtype)
+        width = args[1] if len(args) > 1 else kwargs.get("pad_width")
+        rank = len(a0.dims)
+        dims = list(a0.dims)
+
+        def add(d: object, lo: object, hi: object) -> object:
+            l, h = _known_int(lo), _known_int(hi)
+            if isinstance(d, int) and l is not None and h is not None:
+                return d + l + h
+            return DYN
+
+        if isinstance(width, Const) and isinstance(width.value, int):
+            dims = [add(d, width, width) for d in dims]
+        elif isinstance(width, TupVal):
+            if len(width.items) == 2 and all(
+                    not isinstance(i, TupVal) for i in width.items):
+                lo, hi = width.items
+                dims = [add(d, lo, hi) for d in dims]
+            elif len(width.items) == rank:
+                for i, pair in enumerate(width.items):
+                    if isinstance(pair, TupVal) and len(pair.items) == 2:
+                        dims[i] = add(dims[i], pair.items[0], pair.items[1])
+                    else:
+                        dims[i] = DYN
+            else:
+                dims = [DYN] * rank
+        else:
+            dims = [DYN] * rank
+        new = tuple(dims)
+        return Arr(new, a0.dtype,
+                   chain=extend_chain(a0.chain, ln,
+                                      f"pad -> {fmt_dims(new)}"))
+
+
+# --------------------------------------------------------------------------
+# per-run cache (same identity discipline as ``project_graph``)
+# --------------------------------------------------------------------------
+
+_LAST_SHAPES: Optional[Tuple[Tuple[int, ...], "ProjectShapes"]] = None
+
+
+def project_shapes(modules: Sequence[ModuleInfo]) -> ProjectShapes:
+    """The shared per-run interpreter result for a module list.
+
+    Keyed on module identity so the four shape rules run one analysis
+    between them, mirroring ``project_graph``.
+    """
+    global _LAST_SHAPES
+    key = tuple(id(m) for m in modules)
+    if _LAST_SHAPES is not None and _LAST_SHAPES[0] == key:
+        return _LAST_SHAPES[1]
+    shapes = ProjectShapes(modules)
+    _LAST_SHAPES = (key, shapes)
+    return shapes

@@ -1307,6 +1307,159 @@ def retry(scope):
 '''
 }
 
+BAD_SHAPE_MISMATCH = {
+    "mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def squeeze_batch():
+    """20 elements cannot reshape to 21."""
+    x = jnp.ones((4, 5))
+    return x.reshape(3, 7)
+
+
+def fuse():
+    """Contracting dims disagree: 5 vs 6."""
+    a = jnp.ones((4, 5))
+    b = jnp.ones((6, 7))
+    return a @ b
+'''
+}
+
+GOOD_SHAPE_MISMATCH = {
+    "mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def squeeze_batch():
+    """Element count preserved; -1 inference is fine too."""
+    x = jnp.ones((4, 5))
+    return x.reshape(5, 4).reshape(-1, 2)
+
+
+def fuse(n):
+    """Unknown dims never fire."""
+    a = jnp.ones((4, 5))
+    b = jnp.ones((5, 7))
+    c = jnp.ones((n, 7))
+    return a @ b + c
+'''
+}
+
+BAD_DTYPE_PROMOTION = {
+    "mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def scale(x):
+    """numpy default-f64 grid promotes the traced f32 array to f64."""
+    t = np.linspace(0.0, 1.0, 8)
+    y = jnp.ones((8,), jnp.float32)
+    return y * t
+'''
+}
+
+GOOD_DTYPE_PROMOTION = {
+    "mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def scale(x):
+    """Cast before mixing; python scalars are weak and never promote."""
+    t = np.linspace(0.0, 1.0, 8).astype(np.float32)
+    y = jnp.ones((8,), jnp.float32)
+    return y * t * 0.5
+
+
+def host(x):
+    """f64 outside traced code is host math, not a finding."""
+    return np.linspace(0.0, 1.0, 8) * np.ones(8)
+'''
+}
+
+BAD_VMAP_AXIS_CLASH = {
+    "mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+
+
+def ensemble():
+    """in_axes=2 is out of range for a rank-2 argument."""
+    f = lambda a, b: a + b
+    return jax.vmap(f, in_axes=(0, 2))(jnp.ones((3, 4)), jnp.ones((3, 4)))
+'''
+}
+
+GOOD_VMAP_AXIS_CLASH = {
+    "mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+
+
+def ensemble():
+    """Both mapped axes exist and agree on size."""
+    f = lambda a, b: a + b
+    return jax.vmap(f, in_axes=(0, 0))(jnp.ones((3, 4)), jnp.ones((3, 4)))
+
+
+def broadcast(xs):
+    """None axes and unknown ranks never fire."""
+    f = lambda a, b: a + b
+    return jax.vmap(f, in_axes=(0, None))(jnp.ones((3, 4)), xs)
+'''
+}
+
+BAD_INDIVISIBLE_SHARDING = {
+    "mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def place():
+    """Sequence dim 100 cannot split over the 8-way 'sp' axis."""
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()).reshape(8), ("sp",))
+    spec = jax.sharding.PartitionSpec(None, "sp", None, None)
+    x = jnp.zeros((4, 100, 8, 64))
+    return jax.device_put(x, jax.sharding.NamedSharding(mesh, spec))
+'''
+}
+
+GOOD_INDIVISIBLE_SHARDING = {
+    "mod.py": '''"""m."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def place():
+    """128 % 8 == 0: the paper's badge length divides the mesh axis."""
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()).reshape(8), ("sp",))
+    spec = jax.sharding.PartitionSpec(None, "sp", None, None)
+    x = jnp.zeros((4, 128, 8, 64))
+    return jax.device_put(x, jax.sharding.NamedSharding(mesh, spec))
+
+
+def env_mesh():
+    """Mesh sized from jax.device_count() is Dyn: never fires."""
+    devices = np.asarray(jax.devices()).reshape(jax.device_count())
+    mesh = jax.sharding.Mesh(devices, ("sp",))
+    spec = jax.sharding.PartitionSpec(None, "sp")
+    x = jnp.zeros((4, 100))
+    return jax.device_put(x, jax.sharding.NamedSharding(mesh, spec))
+'''
+}
+
 FIXTURES = {
     "jit-purity": (BAD_JIT_PURITY, GOOD_JIT_PURITY),
     "hardcoded-knob": (BAD_HARDCODED_KNOB, GOOD_HARDCODED_KNOB),
@@ -1332,6 +1485,13 @@ FIXTURES = {
     "escaping-tracer": (BAD_ESCAPING_TRACER, GOOD_ESCAPING_TRACER),
     "unsafe-bus-write": (BAD_UNSAFE_BUS_WRITE, GOOD_UNSAFE_BUS_WRITE),
     "knob-contract": (BAD_KNOB_CONTRACT, GOOD_KNOB_CONTRACT),
+    "shape-mismatch": (BAD_SHAPE_MISMATCH, GOOD_SHAPE_MISMATCH),
+    "dtype-promotion": (BAD_DTYPE_PROMOTION, GOOD_DTYPE_PROMOTION),
+    "vmap-axis-clash": (BAD_VMAP_AXIS_CLASH, GOOD_VMAP_AXIS_CLASH),
+    "indivisible-sharding": (
+        BAD_INDIVISIBLE_SHARDING,
+        GOOD_INDIVISIBLE_SHARDING,
+    ),
 }
 
 
