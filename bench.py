@@ -39,7 +39,12 @@ shape — the prio phase's dominant host cost per HOST_PHASE.json;
 ``TIP_BENCH_SA=0`` skips it), a ``fused_chain`` companion (whole-chain AOT
 run-program throughput, first-walk vs steady-state compile counts and the
 host-transfer bytes/input analytic vs the per-phase activation pull;
-``TIP_BENCH_FUSED_CHAIN=0`` skips it), an ``obs_overhead_seconds`` companion
+``TIP_BENCH_FUSED_CHAIN=0`` skips it), a ``grouped_chain`` companion (the
+cross-run dispatch-fusion sweep: G models scored per chain dispatch via
+``GroupChainRunner``, with measured dispatches/badge, model-inputs/s per
+swept G and the G-invariant 68 B/input host-transfer claim;
+``TIP_BENCH_CHAIN_GROUPS`` overrides the sweep, ``=0`` skips),
+an ``obs_overhead_seconds`` companion
 (seconds per 1000 obs span cycles in the current TIP_OBS_DIR state, so the
 trajectory catches telemetry regressions) and the process's obs metrics
 snapshot (``obs_metrics``: compile counts, watchdog probe outcomes, ...).
@@ -371,6 +376,77 @@ def _child_measure() -> None:
         except Exception as e:  # noqa: BLE001 — record, never fail the bench
             fused_chain_info = {"error": repr(e)[:300]}
 
+    # Grouped-chain companion: sweep the cross-run dispatch-fusion group
+    # size G (engine/run_program.GroupChainRunner — G models per chain
+    # dispatch via a vmapped member chain with stacked per-member threshold
+    # tables) over the same synthetic walk. Per G it records the MEASURED
+    # dispatches/badge (must stay 1.0 per group — the whole point), the
+    # analytic host bytes/input PER MODEL (G-invariant: the fan-out drains
+    # the same pred + quantifiers + scores each member always drained; the
+    # 68 B/input claim for the 12-metric chain is what the trend gate and
+    # tier-1 pin), and inputs/s so obs/store can turn the sweep into
+    # group-featured cost-model rows the planner ranks G with.
+    # TIP_BENCH_CHAIN_GROUPS overrides the sweep (comma ints); =0 skips.
+    grouped_chain_info = None
+    groups_raw = os.environ.get("TIP_BENCH_CHAIN_GROUPS", "").strip()
+    if groups_raw not in ("0", "off") and isinstance(fused_chain_info, dict) \
+            and "error" not in fused_chain_info:
+        try:
+            from simple_tip_tpu.engine.run_program import GroupChainRunner
+
+            if groups_raw:
+                g_values = tuple(
+                    int(tok) for tok in groups_raw.split(",") if tok.strip()
+                )
+            else:
+                g_values = (1, 2) if on_cpu else (1, 2, 4, 8)
+            n_metrics = fused_chain_info["n_metrics"]
+            grouped_bytes = 4 + 4 * 4 + n_metrics * 4
+            n_badges = -(-n_fc // fc_badge)
+            sweep = {}
+            for g in g_values:
+                g_runner = GroupChainRunner(
+                    model,
+                    [params] * g,  # identical weights: throughput, not parity
+                    fc_train,
+                    model.nc_layers,
+                    batch_size=fc_badge,
+                    badge_size=fc_badge,
+                    cache=None,  # price the compile honestly, not a disk hit
+                    group_size=g,
+                )
+                g_runner.evaluate_dataset(fc_test)  # first walk: AOT compile
+                gc1 = obs.metrics_snapshot()["counters"]
+                t0 = time.perf_counter()
+                g_runner.evaluate_dataset(fc_test)  # steady state
+                g_dt = time.perf_counter() - t0
+                gc2 = obs.metrics_snapshot()["counters"]
+                dispatches = _delta(
+                    gc1, gc2, "run_program.group_chain_dispatches"
+                )
+                sweep[str(g)] = {
+                    "models_per_dispatch": g,
+                    "walk_seconds": round(g_dt, 6),
+                    # model-inputs/s: G models x n_fc inputs in one walk
+                    "inputs_per_sec": (
+                        round(g * n_fc / g_dt, 1) if g_dt > 0 else 0.0
+                    ),
+                    "chain_dispatches": dispatches,
+                    "dispatches_per_badge": (
+                        round(dispatches / n_badges, 4) if n_badges else None
+                    ),
+                }
+            grouped_chain_info = {
+                "group_sizes": list(g_values),
+                "n_inputs": n_fc,
+                "badge_size": fc_badge,
+                "n_metrics": n_metrics,
+                "host_bytes_per_input": grouped_bytes,
+                "sweep": sweep,
+            }
+        except Exception as e:  # noqa: BLE001 — record, never fail the bench
+            grouped_chain_info = {"error": repr(e)[:300]}
+
     # Online-serving companion: drive the scoring engine (serving/ —
     # continuous batcher over the warm fused-chain program pool) with the
     # open-loop load generator at three synthetic arrival rates scaled off
@@ -507,6 +583,11 @@ def _child_measure() -> None:
                 **(
                     {"fused_chain": fused_chain_info}
                     if fused_chain_info is not None
+                    else {}
+                ),
+                **(
+                    {"grouped_chain": grouped_chain_info}
+                    if grouped_chain_info is not None
                     else {}
                 ),
                 **({"serving": serving_info} if serving_info is not None else {}),

@@ -11,11 +11,17 @@ artifact roots, then diffs the artifact sets:
   rounding) AND an identical stable descending ordering — the consumer
   contract (ops/uncertainty.py).
 
+A second GROUPED lane then walks 3 synthetic models at group size G=2
+(one ragged tail group) through ``evaluate_group`` — the cross-run
+dispatch-fusion path that scores G models per chain dispatch — and the
+per-model artifact fan-out must be byte-identical to three independent
+``_eval_fused_chain`` walks (same rngs, so even VR matches bit-exactly).
+
 Exit 0 on parity, 1 with a named diff otherwise. CPU-safe and small enough
-for a CI lane (~1 min); the same pin runs as a tier-1 test
-(tests/test_run_program.py::test_fused_artifacts_match_per_phase) — this
-script exists so the LINT lane catches a parity break without waiting for
-the full suite.
+for a CI lane (~1 min); the same pins run as tier-1 tests
+(tests/test_run_program.py::test_fused_artifacts_match_per_phase and
+::test_evaluate_group_matches_per_model_walk) — this script exists so the
+LINT lane catches a parity break without waiting for the full suite.
 
 Usage: python scripts/fused_chain_smoke.py
 """
@@ -75,6 +81,32 @@ def main() -> int:
         )
         got = artifacts()
 
+        # Grouped lane: 3 members, G=2 (groups (0,1) + ragged tail (2)).
+        members = [params] + [
+            init_params(model, jax.random.PRNGKey(100 + g), x_train[:2])
+            for g in (1, 2)
+        ]
+        os.environ["TIP_ASSETS"] = os.path.join(tmp, "group_ref")
+        for mid, p in enumerate(members):
+            ep._eval_fused_chain(
+                case_study, model, p, mid, layers,
+                x_nom, y_nom, x_ood, y_ood, x_train, 32,
+            )
+        group_ref = artifacts()
+
+        os.environ["TIP_ASSETS"] = os.path.join(tmp, "grouped")
+        surprise = ep._eval_surprise
+        ep._eval_surprise = lambda *a, **k: None  # SA is per-member host work
+        try:
+            ep.evaluate_group(
+                [0, 1, 2], case_study, model, lambda mid: members[mid],
+                x_train, x_nom, y_nom, x_ood, y_ood,
+                layers, sa_activation_layers=[], batch_size=32, group_size=2,
+            )
+        finally:
+            ep._eval_surprise = surprise
+        group_got = artifacts()
+
     if set(ref) != set(got):
         print(
             "FUSED-CHAIN PARITY FAIL: artifact sets differ\n"
@@ -101,6 +133,31 @@ def main() -> int:
     print(
         f"FUSED-CHAIN PARITY OK: {len(ref)} artifacts "
         "(ranks/scores/pred byte-identical, uncertainties ULP-close + same order)"
+    )
+
+    if set(group_ref) != set(group_got):
+        print(
+            "GROUPED-CHAIN PARITY FAIL: artifact sets differ\n"
+            f"  per-model only: {sorted(set(group_ref) - set(group_got))}\n"
+            f"  grouped only:   {sorted(set(group_got) - set(group_ref))}"
+        )
+        return 1
+    group_failures = [
+        name for name in sorted(group_ref)
+        if not np.array_equal(group_ref[name], group_got[name])
+    ]
+    if group_failures:
+        print(
+            f"GROUPED-CHAIN PARITY FAIL: {len(group_failures)} artifacts "
+            "diverge from the per-model walk:"
+        )
+        for name in group_failures:
+            print(f"  {name}")
+        return 1
+    print(
+        f"GROUPED-CHAIN PARITY OK: {len(group_ref)} artifacts across 3 "
+        "members at G=2 byte-identical to three per-model walks "
+        "(2 group dispatches per badge instead of 3)"
     )
     return 0
 

@@ -28,6 +28,23 @@ cache cannot outlive one iteration is a retrace risk even when today's
 shapes happen to be constant (the per-badge Python-scalar key — ``valid``
 counts, remainder badge sizes — is exactly what creeps in next).
 
+3. per-member unroll of a stacked pytree inside traced code::
+
+       @jax.jit
+       def group_chain(stacked, x):
+           for g in range(GROUP):
+               member = jax.tree.map(lambda l: l[g], stacked)
+               out.append(apply(member, x))
+
+   the grouped executor's anti-pattern: indexing a stacked member axis
+   with a Python loop variable inside a trace unrolls the group into G
+   per-member subgraphs — G copies of the chain compiled and dispatched
+   where ONE vmapped program (``ops/fused_chain.make_group_chain_fn``)
+   was the point. Flagged when a tree-map-family call inside a loop in
+   jit-reachable code subscripts by the loop variable; the host-side
+   fan-out that slices RESULTS after the dispatch is untraced and does
+   not flag.
+
 Only the JIT FAMILY is tracked (``jax.jit``/``jax.pjit``/``jax.pmap``):
 those are the transforms that own an XLA compile cache keyed on the
 callable object. Trace-time combinators (``vmap``, ``grad``,
@@ -58,6 +75,14 @@ _JIT_FAMILY = {
     "jax.pjit",
     "jax.pmap",
     "jax.experimental.pjit.pjit",
+}
+
+#: Per-leaf pytree mappers: subscripting a stacked member axis through one
+#: of these with a loop variable inside a trace unrolls the group axis.
+_TREE_MAP_FAMILY = {
+    "jax.tree.map",
+    "jax.tree_map",
+    "jax.tree_util.tree_map",
 }
 
 
@@ -105,6 +130,9 @@ class RetraceRiskRule(Rule):
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call):
                 continue
+            if callee_name(node, aliases) in _TREE_MAP_FAMILY:
+                yield from self._member_unroll(node, parents, traced, aliases)
+                continue
             inline = isinstance(node.func, ast.Call) and _is_jit_construction(
                 node.func, aliases
             )
@@ -143,6 +171,64 @@ class RetraceRiskRule(Rule):
                     )
                     break
                 walker = parents.get(walker)
+
+    def _member_unroll(self, node, parents, traced, aliases):
+        """Flag a tree-map call that slices a stacked pytree by a Python
+        loop variable inside jit-reachable code (the group-unroll shape).
+
+        Host-side code never flags (the fan-out after a grouped dispatch
+        legitimately slices results per member); a def boundary between
+        the loop and the call clears the loop variables (the nested
+        function may run once per group outside the loop).
+        """
+        if not self._inside_traced(node, parents, traced):
+            return
+        loop_vars = set()
+        walker = parents.get(node)
+        while walker is not None:
+            if isinstance(walker, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            if isinstance(walker, (ast.For, ast.AsyncFor)):
+                loop_vars.update(
+                    n.id
+                    for n in ast.walk(walker.target)
+                    if isinstance(n, ast.Name)
+                )
+            if isinstance(
+                walker,
+                (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp),
+            ):
+                for gen in walker.generators:
+                    loop_vars.update(
+                        n.id
+                        for n in ast.walk(gen.target)
+                        if isinstance(n, ast.Name)
+                    )
+            walker = parents.get(walker)
+        if not loop_vars:
+            return
+        lambdas = [a for a in node.args if isinstance(a, ast.Lambda)]
+        lambdas += [
+            kw.value for kw in node.keywords if isinstance(kw.value, ast.Lambda)
+        ]
+        for lam in lambdas:
+            for sub in ast.walk(lam.body):
+                if not isinstance(sub, ast.Subscript):
+                    continue
+                if any(
+                    isinstance(n, ast.Name) and n.id in loop_vars
+                    for n in ast.walk(sub.slice)
+                ):
+                    name = dotted(node.func, aliases) or "jax.tree.map"
+                    yield "", node.lineno, (
+                        f"{name}(...) slices a stacked pytree by a loop "
+                        "variable inside traced code: the member loop "
+                        "unrolls into one subgraph per member — G compiles "
+                        "and G dispatches where one vmapped program "
+                        "(ops/fused_chain.make_group_chain_fn) does the "
+                        "whole group; vmap over the stacked axis instead"
+                    )
+                    return
 
     @staticmethod
     def _inside_traced(node, parents, traced) -> bool:

@@ -138,7 +138,14 @@ class CaseStudy:
             for i, model_id in enumerate(group):
                 self.save_params(model_id, unstack(stacked, i))
 
-    def _dispatch_workers(self, phase: str, model_ids: List[int], num_workers: int, phase_kwargs=None) -> None:
+    def _dispatch_workers(
+        self,
+        phase: str,
+        model_ids: List[int],
+        num_workers: int,
+        phase_kwargs=None,
+        group_size: int = 1,
+    ) -> None:
         """Fan the phase out over worker processes (the reference's
         LazyEnsemble axis, reference: src/dnn_test_prio/case_study.py:87-109):
         host-bound per-run work (LSA float64 KDE, KMeans, artifact IO) then
@@ -161,6 +168,7 @@ class CaseStudy:
             num_workers,
             phase_kwargs=phase_kwargs,
             worker_platforms=default_worker_platforms(num_workers, local_chips),
+            group_size=group_size,
         )
 
     def run_prio_eval(self, model_ids: List[int], num_workers: int = 1) -> None:
@@ -168,11 +176,47 @@ class CaseStudy:
 
         ``num_workers > 1`` distributes runs over that many worker
         processes; each run's artifacts are file-granular and idempotent,
-        so failed ids can simply be re-run."""
+        so failed ids can simply be re-run. With the fused chain on and
+        ``TIP_CHAIN_GROUP > 1``, runs are scored in groups of G — one chain
+        dispatch per badge per GROUP (``eval_prioritization.evaluate_group``,
+        artifacts byte-identical to the per-model walk); the scheduler path
+        composes the same way because its work units are G-id groups."""
+        from simple_tip_tpu.engine.run_program import (
+            chain_group_size,
+            fused_chain_enabled,
+        )
+
+        group_size = chain_group_size() if fused_chain_enabled() else 1
         if num_workers > 1 and len(model_ids) > 1:
-            self._dispatch_workers("test_prio", model_ids, num_workers)
+            self._dispatch_workers(
+                "test_prio", model_ids, num_workers, group_size=group_size
+            )
             return
         (x_train, _), (x_test, y_test), (ood_x, ood_y) = self.spec.loader()
+        if group_size > 1 and len(model_ids) > 1:
+            logger.info(
+                "[%s] grouped prioritization eval for runs %s (G=%d)",
+                self.spec.name,
+                list(model_ids),
+                group_size,
+            )
+            eval_prioritization.evaluate_group(
+                model_ids=list(model_ids),
+                case_study=self.spec.name,
+                model_def=self.scoring_model_def,
+                params_loader=self.load_params,
+                training_dataset=x_train,
+                nominal_test_dataset=x_test,
+                nominal_test_labels=y_test,
+                ood_test_dataset=ood_x,
+                ood_test_labels=ood_y,
+                nc_activation_layers=list(self.spec.nc_activation_layers),
+                sa_activation_layers=list(self.spec.sa_activation_layers),
+                dsa_badge_size=self.spec.dsa_badge_size,
+                batch_size=self.spec.prediction_badge_size,
+                group_size=group_size,
+            )
+            return
         for model_id in model_ids:
             params = self.load_params(model_id)
             logger.info("[%s] prioritization eval for run %d", self.spec.name, model_id)

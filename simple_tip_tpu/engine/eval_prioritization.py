@@ -161,6 +161,145 @@ def evaluate(
         )
 
 
+def evaluate_group(
+    model_ids: List[int],
+    case_study: str,
+    model_def,
+    params_loader,
+    training_dataset: np.ndarray,
+    nominal_test_dataset: np.ndarray,
+    nominal_test_labels: np.ndarray,
+    ood_test_dataset: np.ndarray,
+    ood_test_labels: np.ndarray,
+    nc_activation_layers: List,
+    sa_activation_layers: List[int],
+    dsa_badge_size: Optional[int] = None,
+    batch_size: int = 32,
+    group_size: Optional[int] = None,
+) -> None:
+    """Grouped test-prioritization walk: G models per chain dispatch.
+
+    ``params_loader(model_id) -> params`` pulls member checkpoints;
+    ``model_ids`` is chunked into groups of ``group_size``
+    (``TIP_CHAIN_GROUP`` by default), each scored by ONE
+    ``GroupChainRunner`` so a badge costs one dispatch for the whole group.
+    While group i walks its badges, group i+1's stacked weights are ALREADY
+    in flight to the device (``GroupChainRunner.stage`` — ``device_put`` is
+    asynchronous), so weight upload overlaps badge scoring: the double
+    buffer. The per-member artifact set persisted is byte-identical to what
+    per-model ``evaluate`` writes (parity-pinned); surprise adequacy stays
+    per-member (host sklearn fits, not XLA-loweable).
+    """
+    from simple_tip_tpu.engine.run_program import GroupChainRunner, chain_group_size
+
+    g_size = int(group_size or chain_group_size())
+    ids = list(model_ids)
+    groups = [ids[i : i + g_size] for i in range(0, len(ids), g_size)]
+
+    def _load(group):
+        return [params_loader(mid) for mid in group]
+
+    params = _load(groups[0])
+    staged = GroupChainRunner.stage(params, g_size)
+    for gi, group in enumerate(groups):
+        cur_params, cur_staged = params, staged
+        if gi + 1 < len(groups):
+            params = _load(groups[gi + 1])
+            staged = GroupChainRunner.stage(params, g_size)
+        with obs.span(
+            "prio.group_chain", model_ids=list(group), group_size=g_size
+        ):
+            _eval_fused_chain_group(
+                case_study,
+                model_def,
+                list(zip(group, cur_params)),
+                nc_activation_layers,
+                nominal_test_dataset,
+                nominal_test_labels,
+                ood_test_dataset,
+                ood_test_labels,
+                training_dataset,
+                batch_size,
+                group_size=g_size,
+                staged_params=cur_staged,
+            )
+        for model_id, member_params in zip(group, cur_params):
+            with obs.span("prio.surprise", model_id=model_id):
+                _eval_surprise(
+                    case_study,
+                    model_def,
+                    member_params,
+                    model_id,
+                    sa_activation_layers,
+                    nominal_test_dataset,
+                    ood_test_dataset,
+                    training_dataset,
+                    dsa_badge_size=dsa_badge_size,
+                )
+
+
+def _eval_fused_chain_group(
+    case_study,
+    model_def,
+    members,
+    nc_layers,
+    nominal_test_dataset,
+    nominal_test_labels,
+    ood_test_dataset,
+    ood_test_labels,
+    training_dataset,
+    batch_size,
+    group_size=None,
+    staged_params=None,
+):
+    """``_eval_fused_chain`` for one member group: one runner scores every
+    member per badge, then fans results out to the IDENTICAL per-model
+    artifact set (same writers, same file contract — parity-pinned)."""
+    from simple_tip_tpu.engine.run_program import GroupChainRunner
+
+    runner = GroupChainRunner(
+        model_def,
+        [p for _, p in members],
+        training_dataset,
+        nc_layers,
+        batch_size=batch_size,
+        group_size=group_size,
+        staged_params=staged_params,
+    )
+    datasets = {
+        "nominal": (nominal_test_dataset, nominal_test_labels),
+        "ood": (ood_test_dataset, ood_test_labels),
+    }
+    for ds_type, (ds, labels) in datasets.items():
+        results = runner.evaluate_dataset(
+            ds, rngs=[jax.random.PRNGKey(mid) for mid, _ in members]
+        )
+        labels_flat = np.asarray(labels).flatten()
+        for (model_id, _), result in zip(members, results):
+            is_misclassified = result["pred"] != labels_flat
+            _persist(
+                case_study, ds_type, "is_misclassified", model_id, is_misclassified
+            )
+            _persist_times_multiple_metrics(
+                case_study, ds_type, model_id, result["unc_times"]
+            )
+            for unc_id, unc in result["uncertainties"].items():
+                _persist(case_study, ds_type, f"uncertainty_{unc_id}", model_id, unc)
+            _persist_times_multiple_metrics(
+                case_study, ds_type, model_id, result["cov_times"]
+            )
+            for metric_id, score in result["scores"].items():
+                _persist(case_study, ds_type, f"{metric_id}_scores", model_id, score)
+            for metric_id, order in result["cam_orders"].items():
+                _persist(
+                    case_study,
+                    ds_type,
+                    f"{metric_id}_cam_order",
+                    model_id,
+                    np.array(order),
+                )
+
+
 def _eval_surprise(
     case_study,
     model_def,

@@ -68,6 +68,28 @@ def int8_profiles_enabled() -> bool:
     )
 
 
+def chain_group_size() -> int:
+    """Cross-run dispatch fusion group size from ``TIP_CHAIN_GROUP``.
+
+    The number of models scored per chain dispatch: the study walks the
+    same test inputs across R independently trained runs, so grouping G of
+    them into one vmapped dispatch turns R dispatches per badge into
+    ceil(R/G) (``GroupChainRunner``). Empty / ``0`` / ``off`` / ``1`` means
+    ungrouped (the per-model ``FusedChainRunner`` walk).
+    """
+    raw = os.environ.get("TIP_CHAIN_GROUP", "").strip().lower()
+    if not raw or raw == "off":
+        return 1
+    try:
+        g = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"TIP_CHAIN_GROUP={raw!r} not recognized "
+            "(positive integer group size, or off)"
+        )
+    return max(g, 1)
+
+
 def program_cache_max_bytes() -> Optional[int]:
     """Size cap from ``TIP_PROGRAM_CACHE_MAX_BYTES`` (same grammar as
     ``TIP_SA_CACHE_MAX_BYTES``: plain bytes or k/m/g suffix; empty / ``0``
@@ -660,3 +682,423 @@ class FusedChainRunner:
         assert (
             len(order) == len(set(int(i) for i in order)) == scores.shape[0]
         ), "CAM order is not unique or not complete"
+
+
+class GroupChainRunner:
+    """G models' whole-chain prio evaluation in ONE dispatch per badge.
+
+    The study shape is R independently trained runs walked over the SAME
+    test inputs; the per-model ``FusedChainRunner`` still pays one chain
+    dispatch per badge per model. This runner stacks G member checkpoints
+    into one pytree (``parallel/ensemble.stack_params`` — the layout
+    ``train_ensemble`` already proved) and scores a badge for all G members
+    with one vmapped dispatch, so R runs cost ceil(R/G) dispatches per
+    badge instead of R.
+
+    Per-member threshold statistics (NBC/SNAC/KMNC boundaries come from
+    each member's OWN training activations) ride as traced inputs — the
+    stacked ``ThresholdCodebook.table`` triple — so one compiled program
+    serves every member and every group of the same shape; see
+    ``ops/fused_chain.make_member_chain_fn``. A ragged final group
+    (``len(members) < group_size``) is padded by repeating member 0 with a
+    traced member-valid scalar zeroing the padding members' packed
+    profiles, so the tail reuses the same compiled shape.
+
+    ``evaluate_dataset`` returns ONE result dict per real member, each
+    contract-identical to ``FusedChainRunner.evaluate_dataset`` — the
+    fan-out that keeps ``eval_prioritization``'s per-model artifacts
+    byte-identical to the per-model walk (parity-pinned in tests and
+    ``scripts/fused_chain_smoke.py``).
+    """
+
+    def __init__(
+        self,
+        model_def,
+        params_list,
+        training_set: np.ndarray,
+        nc_layers,
+        batch_size: int = 32,
+        badge_size: Optional[int] = None,
+        cache: Optional[ProgramCache] = "env",
+        group_size: Optional[int] = None,
+        staged_params=None,
+    ):
+        import jax
+
+        from simple_tip_tpu.engine.coverage_handler import (
+            PROFILE_BADGE_SIZE,
+            CoverageWorker,
+        )
+        from simple_tip_tpu.engine.model_handler import BaseModel
+        from simple_tip_tpu.ops.fused_chain import (
+            ThresholdCodebook,
+            make_group_chain_fn,
+            rank_badges_grouped,
+        )
+
+        if not params_list:
+            raise ValueError("GroupChainRunner needs at least one member")
+        self.model_def = model_def
+        self.params_list = list(params_list)
+        self.n_members = len(self.params_list)
+        self.group_size = int(group_size or self.n_members)
+        if self.n_members > self.group_size:
+            raise ValueError(
+                f"{self.n_members} members exceed group_size={self.group_size}"
+            )
+        self.batch_size = batch_size
+        self.badge_size = badge_size or PROFILE_BADGE_SIZE
+        self.layer_ids = tuple(i for i in nc_layers if isinstance(i, int))
+        self.cache = ProgramCache.from_env() if cache == "env" else cache
+
+        # One CoverageWorker per member: each member's thresholds come from
+        # ITS training-stats pass (shared via CoverageStatsCache), exactly
+        # as the per-model walk computes them — the parity precondition.
+        self.workers = [
+            CoverageWorker(
+                base_model=BaseModel(
+                    model_def, p, activation_layers=nc_layers, batch_size=batch_size
+                ),
+                training_set=training_set,
+            )
+            for p in self.params_list
+        ]
+        self._codebooks = [ThresholdCodebook(w.metrics) for w in self.workers]
+        sig0 = self._codebooks[0].spec_signature()
+        for g, cb in enumerate(self._codebooks[1:], start=1):
+            if cb.spec_signature() != sig0:
+                raise ValueError(
+                    f"member {g} metric structure differs from member 0; "
+                    "group members must share metric configuration"
+                )
+        self._spec_sig = hashlib.sha256(repr(sig0).encode()).hexdigest()
+        self.metrics = self.workers[0].metrics
+
+        self.stacked_params = (
+            staged_params
+            if staged_params is not None
+            else self.stage(self.params_list, self.group_size)
+        )
+
+        group_chain = make_group_chain_fn(
+            model_def, self.layer_ids, self.metrics, member_tables=True
+        )
+        # donate the badge buffer (arg 2); the stacked weights and tables
+        # STAY device-resident across the whole walk
+        self._group_jit = jax.jit(group_chain, donate_argnums=_donate(2))
+        self._grank_jit = jax.jit(rank_badges_grouped, donate_argnums=_donate(0))
+        self._tables = {}  # n_neurons -> stacked (vals, strict, rank)
+        self._chain_compiled = {}  # (shape, dtype) -> executable
+        self._rank_compiled = {}  # (num_badges, words) -> executable
+        self._select_compiled = {}  # (n, k) -> executable
+
+    @staticmethod
+    def stage(params_list, group_size: Optional[int] = None):
+        """Stack member checkpoints and START the host->device upload.
+
+        ``jax.device_put`` is asynchronous, so staging group i+1 BEFORE
+        walking group i's badges overlaps the next group's weight transfer
+        with the current group's badge scoring — the double buffer the
+        grouped study walk in ``eval_prioritization`` drives. Pads a ragged
+        tail to ``group_size`` by repeating member 0 (the inert-padding
+        contract; the member-valid scalar keeps pad members unpickable).
+        """
+        import jax
+
+        from simple_tip_tpu.parallel.ensemble import stack_params
+
+        g = int(group_size or len(params_list))
+        members = list(params_list) + [params_list[0]] * (g - len(params_list))
+        return jax.device_put(stack_params(members))
+
+    # -- program resolution ---------------------------------------------------
+
+    def _n_neurons(self, x_shape, x_dtype) -> int:
+        """Flattened tapped-activation width for one badge shape (shape-only
+        ``jax.eval_shape`` — no compile, no dispatch)."""
+        import jax
+
+        member_specs = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype),
+            self.params_list[0],
+        )
+        x_spec = jax.ShapeDtypeStruct(tuple(x_shape), x_dtype)
+        _, taps = jax.eval_shape(
+            lambda p, xb: self.model_def.apply({"params": p}, xb, train=False),
+            member_specs,
+            x_spec,
+        )
+        acts = [taps[i] for i in self.layer_ids]
+        return sum(int(np.prod(a.shape[1:])) for a in acts)
+
+    def _tables_for(self, n_neurons: int):
+        """The member cut tables stacked over the G axis, device-resident
+        (pad members repeat member 0's table, matching the padded stack)."""
+        import jax
+
+        cached = self._tables.get(n_neurons)
+        if cached is not None:
+            return cached
+        per_member = [cb.table(n_neurons) for cb in self._codebooks]
+        per_member += [per_member[0]] * (self.group_size - self.n_members)
+        stacked = tuple(
+            np.stack([t[i] for t in per_member]) for i in range(3)
+        )
+        entry = jax.device_put(stacked)
+        self._tables[n_neurons] = entry
+        return entry
+
+    def _chain_program(self, x_shape, x_dtype):
+        import jax
+
+        key = (tuple(x_shape), str(x_dtype))
+        prog = self._chain_compiled.get(key)
+        if prog is None:
+            n_neurons = self._n_neurons(x_shape, x_dtype)
+            k_cuts = len(self._codebooks[0]._cuts)
+            # thresholds are runtime INPUTS here, so only the coding
+            # STRUCTURE keys the program; the config-only metrics the
+            # codebook does not cover (TKNC) stay baked and key as usual
+            baked = {
+                mid: m
+                for mid, m in self.metrics.items()
+                if not self._codebooks[0].covers(mid)
+            }
+            fp = program_fingerprint(
+                self.model_def,
+                self.stacked_params,
+                self.layer_ids,
+                baked,
+                x_shape,
+                x_dtype,
+                "group_chain",
+                f"group={self.group_size}",
+                f"spec={self._spec_sig}",
+                f"table={n_neurons}x{k_cuts}",
+            )
+            param_specs = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype),
+                self.stacked_params,
+            )
+            table_specs = tuple(
+                jax.ShapeDtypeStruct(
+                    (self.group_size, n_neurons, k_cuts), np.dtype(dt)
+                )
+                for dt in (np.float32, bool, np.int32)
+            )
+            x_spec = jax.ShapeDtypeStruct(tuple(x_shape), x_dtype)
+            scalar_i32 = jax.ShapeDtypeStruct((), np.dtype(np.int32))
+            prog = aot_compile(
+                self._group_jit,
+                (param_specs, table_specs, x_spec, scalar_i32, scalar_i32),
+                self.cache,
+                fp,
+                program="group_chain",
+            )
+            self._chain_compiled[key] = prog
+        return prog
+
+    def _rank_program(self, num_badges: int, words: int):
+        import jax
+
+        key = (num_badges, words)
+        prog = self._rank_compiled.get(key)
+        if prog is None:
+            fp = rank_fingerprint(
+                num_badges,
+                self.badge_size,
+                words,
+                f"group={self.group_size}",
+            )
+            spec = tuple(
+                jax.ShapeDtypeStruct(
+                    (self.group_size, self.badge_size, words),
+                    np.dtype(np.uint32),
+                )
+                for _ in range(num_badges)
+            )
+            prog = aot_compile(
+                self._grank_jit, (spec,), self.cache, fp, program="group_rank"
+            )
+            self._rank_compiled[key] = prog
+        return prog
+
+    def _select_program(self, n: int, k: int):
+        import jax
+
+        from simple_tip_tpu.ops.fused_chain import make_group_select_fn
+
+        key = (int(n), int(k))
+        prog = self._select_compiled.get(key)
+        if prog is None:
+            fp = select_fingerprint(n, k, f"group={self.group_size}")
+            spec = (
+                jax.ShapeDtypeStruct(
+                    (self.group_size, int(n)), np.dtype(np.float32)
+                ),
+                jax.ShapeDtypeStruct((), np.dtype(np.int32)),
+            )
+            prog = aot_compile(
+                jax.jit(make_group_select_fn(int(k))),
+                spec,
+                self.cache,
+                fp,
+                program="group_select",
+            )
+            self._select_compiled[key] = prog
+        return prog
+
+    # -- evaluation -----------------------------------------------------------
+
+    def evaluate_dataset(self, x: np.ndarray, rngs=None, select_k=None):
+        """Grouped prio evaluation of one test set: one chain dispatch per
+        badge scores ALL members; one rank dispatch per metric ranks all
+        members' CAM walks.
+
+        Returns a LIST of per-member result dicts (real members only, in
+        constructor order), each with the exact
+        ``FusedChainRunner.evaluate_dataset`` contract. ``rngs`` is an
+        optional per-member rng list for the MC-dropout VR pass (the
+        stochastic vote pass stays per-member — it cannot fuse into the
+        deterministic group program without changing the per-model vote
+        streams the parity pin protects). Group wall-clock times are
+        attributed to members as the 1/G amortized share.
+        """
+        from simple_tip_tpu.ops.prioritizers import _with_score_tail
+
+        n = int(x.shape[0])
+        bs = self.badge_size
+        m = self.n_members
+        x = np.asarray(x)
+        prog = self._chain_program((bs,) + x.shape[1:], x.dtype)
+        tables = self._tables_for(self._n_neurons((bs,) + x.shape[1:], x.dtype))
+
+        preds = [[] for _ in range(m)]
+        unc_acc = [{} for _ in range(m)]
+        score_acc = [{} for _ in range(m)]
+        packed_acc: Dict[str, list] = {mid: [] for mid in self.metrics}
+        chain_s = 0.0
+        for start in range(0, n, bs):
+            xb = x[start : start + bs]
+            valid = xb.shape[0]
+            if valid < bs:
+                xb = np.concatenate(
+                    [xb, np.zeros((bs - valid,) + x.shape[1:], x.dtype)]
+                )
+            timer = Timer()
+            with timer:
+                pred_b, unc_b, cov_b = prog(
+                    self.stacked_params,
+                    tables,
+                    xb,
+                    np.int32(valid),
+                    np.int32(m),
+                )
+                obs.counter("run_program.group_chain_dispatches").inc()
+                pb = np.asarray(pred_b)
+                for g in range(m):
+                    preds[g].append(pb[g, :valid])
+                for name, u in unc_b.items():
+                    ub = np.asarray(u)
+                    for g in range(m):
+                        unc_acc[g].setdefault(name, []).append(ub[g, :valid])
+                for mid, (s, p) in cov_b.items():
+                    sb = np.asarray(s)
+                    for g in range(m):
+                        score_acc[g].setdefault(mid, []).append(sb[g, :valid])
+                    packed_acc[mid].append(p)  # [G, bs, W], stays on device
+            chain_s += timer.get()
+
+        share = chain_s / m  # amortized per-member chain time
+        results = []
+        for g in range(m):
+            pred = np.concatenate(preds[g], axis=0)
+            uncertainties = {
+                k: np.concatenate(v, axis=0) for k, v in unc_acc[g].items()
+            }
+            scores = {
+                k: np.concatenate(v, axis=0) for k, v in score_acc[g].items()
+            }
+            unc_times = {name: [0, share, 0.0, 0] for name in uncertainties}
+            cov_times = {
+                mid: [self.workers[g].setup_times[mid], share, 0.0]
+                for mid in self.metrics
+            }
+            results.append(
+                {
+                    "pred": pred,
+                    "uncertainties": uncertainties,
+                    "unc_times": unc_times,
+                    "scores": scores,
+                    "cam_orders": {},
+                    "cov_times": cov_times,
+                }
+            )
+
+        for mid in self.metrics:
+            badges = packed_acc[mid]
+            words = int(badges[0].shape[2])
+            rank_prog = self._rank_program(len(badges), words)
+            timer = Timer(name="run_program.group_rank", metric=mid)
+            with timer:
+                picked_dev, count_dev = rank_prog(tuple(badges))
+                obs.counter("run_program.group_rank_dispatches").inc()
+                picked_all = np.asarray(picked_dev)
+                counts = np.asarray(count_dev)
+            rank_share = timer.get() / m
+            for g in range(m):
+                picked = picked_all[g, : int(counts[g])].astype(np.int64)
+                order = _with_score_tail(results[g]["scores"][mid], picked)
+                results[g]["cov_times"][mid].append(rank_share)
+                results[g]["cam_orders"][mid] = order
+                FusedChainRunner._sanity_check(order, results[g]["scores"][mid])
+
+        if rngs is not None and getattr(self.model_def, "has_dropout", False):
+            for g in range(m):
+                self._add_variation_ratio(
+                    g,
+                    x,
+                    rngs[g],
+                    results[g]["uncertainties"],
+                    results[g]["unc_times"],
+                )
+        if select_k:
+            padded_n = -(-n // bs) * bs
+            sel_prog = self._select_program(padded_n, int(select_k))
+            for name in results[0]["uncertainties"]:
+                vals = np.zeros((self.group_size, padded_n), np.float32)
+                for g in range(m):
+                    vals[g, :n] = np.asarray(
+                        results[g]["uncertainties"][name], np.float32
+                    )
+                picked = np.asarray(sel_prog(vals, np.int32(n)))
+                obs.counter("run_program.select_dispatches").inc()
+                for g in range(m):
+                    results[g].setdefault("al_select", {})[name] = picked[
+                        g
+                    ].astype(np.int64)
+        return results
+
+    def _add_variation_ratio(self, g, x, rng, uncertainties, unc_times):
+        """Member ``g``'s MC-dropout VR, exactly as the per-model path
+        computes it (same vote function, same rng stream, same batch
+        policy) — parity requires the per-member vote streams unchanged."""
+        from simple_tip_tpu.engine.model_handler import DROPOUT_SAMPLE_SIZE
+        from simple_tip_tpu.models.train import mc_dropout_votes
+
+        sampling_timer = Timer()
+        with sampling_timer:
+            counts = mc_dropout_votes(
+                self.model_def,
+                self.params_list[g],
+                x,
+                n_samples=DROPOUT_SAMPLE_SIZE,
+                rng=rng,
+                batch_size=max(self.batch_size, 128),
+            )
+        quant_timer = Timer()
+        with quant_timer:
+            majority_count = counts.max(axis=1)
+            vr = 1.0 - majority_count / DROPOUT_SAMPLE_SIZE
+        uncertainties["VR"] = vr
+        unc_times["VR"] = [0, sampling_timer.get(), quant_timer.get(), 0]

@@ -537,6 +537,7 @@ def _predict(args) -> int:
         platform=args.platform,
         workers=args.workers,
         batch=args.batch,
+        group=args.group,
     )
     if args.json:
         print(json.dumps(result, indent=2, sort_keys=True))
@@ -696,6 +697,11 @@ def main(argv=None) -> int:
     pp.add_argument(
         "--batch", type=float, default=None, metavar="N",
         help="batch-size feature for the fit",
+    )
+    pp.add_argument(
+        "--group", type=int, default=None, metavar="G",
+        help="cross-run dispatch-fusion group size feature "
+        "(TIP_CHAIN_GROUP; default 1 = ungrouped)",
     )
     pp.add_argument(
         "--index", default=None, metavar="DIR",

@@ -23,7 +23,7 @@ Honesty rules:
   can judge.
 
 The solver is normal equations + Gaussian elimination with a small ridge
-term — 4 features never justify a linear-algebra dependency, and this must
+term — 5 features never justify a linear-algebra dependency, and this must
 run in the tier-0 dependency-free CI gate.
 """
 
@@ -39,14 +39,23 @@ DEFAULT_MIN_ROWS = 3
 RIDGE = 1e-6
 
 
-def _features(platform, count, batch) -> list:
-    """The feature vector of one observation: [1, cpu?, ln(1+n), ln(1+batch)]."""
+def _features(platform, count, batch, group=None) -> list:
+    """The feature vector of one observation:
+    ``[1, cpu?, ln(1+n), ln(1+batch), ln(group)]``.
+
+    ``group`` is the cross-run dispatch-fusion group size (models per chain
+    dispatch, ``TIP_CHAIN_GROUP``); ``ln(group)`` is 0 at the ungrouped
+    baseline (group=1 or absent), so corpora without grouped rows fit the
+    exact pre-group model (the ridge pins the dead column's coefficient to
+    ~0) and grouped rows teach the G-vs-throughput slope the planner ranks.
+    """
     cpu = 1.0 if str(platform or "").lower() == "cpu" else 0.0
     return [
         1.0,
         cpu,
         math.log1p(max(float(count or 1), 1.0)),
         math.log1p(max(float(batch or 0), 0.0)),
+        math.log(max(float(group or 1), 1.0)),
     ]
 
 
@@ -111,7 +120,10 @@ def fit(rows, min_rows: int = DEFAULT_MIN_ROWS) -> dict:
         count = max(float(row.get("count") or 1), 1.0)
         by_phase.setdefault(str(row.get("phase")), []).append(
             (
-                _features(row.get("platform"), count, row.get("batch")),
+                _features(
+                    row.get("platform"), count, row.get("batch"),
+                    row.get("group"),
+                ),
                 float(secs) / count,
             )
         )
@@ -141,7 +153,8 @@ def fit(rows, min_rows: int = DEFAULT_MIN_ROWS) -> dict:
     return {"phases": phases, "rows_used": used}
 
 
-def phase_estimate(model: dict, phase: str, platform=None, batch=None):
+def phase_estimate(model: dict, phase: str, platform=None, batch=None,
+                   group=None):
     """``(seconds_per_run, error_s, basis)`` for one phase, or Nones.
 
     ``basis`` is ``model`` (trusted fit), ``median`` (insufficient corpus
@@ -151,7 +164,7 @@ def phase_estimate(model: dict, phase: str, platform=None, batch=None):
     if entry is None:
         return None, None, "missing"
     if entry["sufficient"] and entry["coef"]:
-        x = _features(platform, 1, batch)
+        x = _features(platform, 1, batch, group)
         est = sum(c * f for c, f in zip(entry["coef"], x))
         return max(est, 0.0), entry["mae_s"] or 0.0, "model"
     return entry["median_s"], entry["median_s"], "median"
@@ -165,6 +178,7 @@ def predict_study(
     platform=None,
     workers: int = 1,
     batch=None,
+    group=None,
 ) -> dict:
     """Wall-clock estimate of ``case_studies x runs`` over ``phases``.
 
@@ -182,7 +196,9 @@ def predict_study(
     total = err = 0.0
     any_estimate = False
     for phase in phases:
-        per_run, per_err, basis = phase_estimate(model, phase, platform, batch)
+        per_run, per_err, basis = phase_estimate(
+            model, phase, platform, batch, group
+        )
         if basis != "model":
             insufficient.append(phase)
         if per_run is None:

@@ -123,6 +123,26 @@ def _normalize_bench(doc: dict, source: str) -> dict:
             snap["phases"][f"sa_fit.{variant}"] = float(secs)
     if isinstance(sa.get("total"), (int, float)):
         snap["phases"]["sa_fit.total"] = float(sa["total"])
+    # Fused/grouped-chain host-transfer claim: the analytic bytes/input
+    # the chain drains to host (68 B for the 12-metric chain) becomes a
+    # gated "phase" — growth past the band means someone widened the
+    # device->host fan-out, which is exactly the regression the fused
+    # chain exists to prevent. Units are bytes, not seconds; the growth
+    # gate is unit-agnostic.
+    fc = doc.get("fused_chain") or {}
+    if isinstance(fc, dict) and isinstance(
+        fc.get("host_transfer_bytes_per_input"), (int, float)
+    ):
+        snap["phases"]["fused_chain.host_bytes_per_input"] = float(
+            fc["host_transfer_bytes_per_input"]
+        )
+    grouped = doc.get("grouped_chain") or {}
+    if isinstance(grouped, dict) and isinstance(
+        grouped.get("host_bytes_per_input"), (int, float)
+    ):
+        snap["phases"]["grouped_chain.host_bytes_per_input"] = float(
+            grouped["host_bytes_per_input"]
+        )
     # Serving companion: p99 per arrival rate becomes a gated phase so a
     # latency regression on the online path fails `obs trend` exactly like
     # a batch-phase slowdown.

@@ -23,6 +23,8 @@ tiplint rule enforces that every obs JSONL writer carries one):
 - target: ``seconds`` (what the cost model fits) or ``value`` (bench
   throughput, higher-is-better);
 - features: ``count``, ``platform``, ``degraded``, ``batch``, ``workers``,
+  ``group`` (cross-run dispatch-fusion group size; None on ungrouped
+  sources — ``costmodel._features`` treats it as 1),
   ``compiles``, ``device_peak_bytes``, ``health`` (summed health counters),
   ``case_study``, ``captured`` (epoch seconds when the source states one),
   ``plan`` (the ExecutionPlan id the run executed under, ``"unplanned"``
@@ -168,6 +170,7 @@ def _blank_row(kind: str, source: str, seq: int) -> dict:
         "degraded": None,
         "batch": None,
         "workers": None,
+        "group": None,
         "compiles": None,
         "device_peak_bytes": None,
         "health": None,
@@ -348,6 +351,43 @@ def _rows_from_bench(path: str, seq: int) -> list:
         row["phase"] = "obs.overhead_per_1k_spans"
         row["seconds"] = float(doc["obs_overhead_seconds"])
         rows.append(row)
+    # Grouped-chain companion: the G sweep becomes group-featured rows —
+    # the walk seconds train the cost model's log(group) coefficient
+    # (count = G x inputs, so seconds/count is per MODEL-input and the
+    # planner's coordinate descent can rank G), and the analytic host
+    # bytes/input rides as a value row the trend gate watches (the
+    # 68 B/input claim for the 12-metric chain).
+    grouped = doc.get("grouped_chain") or {}
+    if isinstance(grouped, dict) and "error" not in grouped:
+        if isinstance(grouped.get("host_bytes_per_input"), (int, float)):
+            row = base()
+            row["phase"] = "grouped_chain.host_bytes_per_input"
+            row["value"] = float(grouped["host_bytes_per_input"])
+            rows.append(row)
+        n_inputs = grouped.get("n_inputs")
+        for g_label, entry in sorted((grouped.get("sweep") or {}).items()):
+            if not isinstance(entry, dict):
+                continue
+            try:
+                g = int(g_label)
+            except ValueError:
+                continue
+            if isinstance(entry.get("walk_seconds"), (int, float)) and \
+                    isinstance(n_inputs, (int, float)) and n_inputs > 0:
+                row = base()
+                row["phase"] = "grouped_chain.walk"
+                row["seconds"] = float(entry["walk_seconds"])
+                row["count"] = int(g * n_inputs)
+                row["group"] = g
+                row["batch"] = grouped.get("badge_size") or row["batch"]
+                rows.append(row)
+            for field in ("inputs_per_sec", "dispatches_per_badge"):
+                if isinstance(entry.get(field), (int, float)):
+                    row = base()
+                    row["phase"] = f"grouped_chain.{field}"
+                    row["value"] = float(entry[field])
+                    row["group"] = g
+                    rows.append(row)
     # Serving companion (schema 1): per-arrival-rate SLO features so the
     # learned cost model and the trend gate see the online path.
     serving = doc.get("serving") or {}

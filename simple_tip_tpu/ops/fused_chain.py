@@ -112,6 +112,21 @@ class ThresholdCodebook:
         """True when this metric's bits derive from the code."""
         return mid in self._specs
 
+    def spec_signature(self) -> tuple:
+        """Hashable STRUCTURAL identity of the coding: metric ids, spec
+        kinds, cut indices and per-cut strictness. Group members must agree
+        on this (their cut VALUES differ — those ride the table)."""
+
+        def _norm(spec):
+            return tuple(
+                tuple(x) if isinstance(x, list) else x for x in spec
+            )
+
+        return (
+            tuple((mid, _norm(spec)) for mid, spec in sorted(self._specs.items())),
+            tuple(s for _, s in self._cuts),
+        )
+
     def _ensure(self, n_neurons: int):
         """Per-neuron sorted cut table + per-cut ranks (host numpy, cached
         per neuron count — one table per traced activation width)."""
@@ -139,15 +154,47 @@ class ThresholdCodebook:
         self._finalized[n_neurons] = entry
         return entry
 
+    def table(self, n_neurons: int):
+        """The cut table as plain arrays: ``(sorted_vals f32 [N, K],
+        sorted_strict bool [N, K], rank int32 [N, K])``.
+
+        This is the per-member payload the grouped chain stacks over the G
+        axis and passes as TRACED inputs: thresholds are per-member train
+        statistics, so baking them as constants would need one compiled
+        program per member — exactly the dispatch scaling grouping removes.
+        f32 cast happens here (host, round-to-nearest) so the traced
+        comparison is bit-identical to the baked-constant path, where jax
+        performs the same narrowing on the f64 table at op time.
+        """
+        sorted_vals, sorted_strict, rank = self._ensure(n_neurons)
+        return (
+            np.asarray(sorted_vals, np.float32),
+            np.asarray(sorted_strict, bool),
+            np.asarray(rank, np.int32),
+        )
+
     def apply(self, flat_acts) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
         """``{metric_id: (scores, bool profiles)}`` from one coded sweep.
 
         ``flat_acts``: traced [B, N] activation matrix (``flatten_layers``
         output). Profile shapes match the plain metrics' outputs exactly.
         """
+        return self.apply_tables(flat_acts, self.table(flat_acts.shape[1]))
+
+    def apply_tables(
+        self, flat_acts, tables
+    ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+        """``apply`` with the cut table supplied as (possibly traced) arrays.
+
+        ``tables`` is a ``table(...)``-shaped triple; the grouped chain
+        vmaps this over a leading member axis so ONE program serves G
+        members with G different threshold sets. The derivation is the same
+        integer/compare arithmetic either way, so outputs are bit-identical
+        to the baked-constant ``apply``.
+        """
         import jax.numpy as jnp
 
-        sorted_vals, sorted_strict, rank = self._ensure(flat_acts.shape[1])
+        sorted_vals, sorted_strict, rank = tables
         a = flat_acts[:, :, None]
         passed = jnp.where(
             sorted_strict[None], a > sorted_vals[None], a >= sorted_vals[None]
@@ -225,30 +272,108 @@ def make_chain_fn(
     return chain
 
 
+def make_member_chain_fn(
+    model_def,
+    layer_ids: Sequence,
+    metrics: Dict[str, object],
+    quantifiers: Optional[Dict] = None,
+):
+    """One group member's chain with its cut table as a TRACED input:
+    ``(params, tables, xb, valid) -> (pred, unc, cov)``.
+
+    The grouped executor scores G independently trained models in one
+    dispatch, but the threshold-family metrics (NBC/SNAC/KMNC boundaries)
+    are per-member TRAINING statistics — baked as constants they would
+    force one compiled program per member, which is exactly the dispatch
+    scaling grouping exists to remove. So here the threshold families
+    always ride the int8 codebook with the cut table an argument
+    (``ThresholdCodebook.table`` triple; ``make_group_chain_fn`` stacks one
+    per member over the G axis), while config-only metrics (TKNC's top-k
+    ranks, identical across members by construction) stay closed over.
+
+    ``metrics`` supplies the coding STRUCTURE (families, spec layout) and
+    must be structurally identical across members — callers assert with
+    ``ThresholdCodebook.spec_signature``.
+    """
+    import jax.numpy as jnp
+
+    quantifiers = dict(POINT_PRED_QUANTIFIERS if quantifiers is None else quantifiers)
+    layer_ids = tuple(i for i in layer_ids if isinstance(i, int))
+    codebook = ThresholdCodebook(metrics)
+
+    def member_chain(params, tables, xb, valid):
+        probs, taps = model_def.apply({"params": params}, xb, train=False)
+        acts = [taps[i] for i in layer_ids]
+        pred = jnp.argmax(probs, axis=1)
+        unc = {name: fn(probs)[1] for name, fn in quantifiers.items()}
+        mask = jnp.arange(xb.shape[0]) < valid
+        coded = codebook.apply_tables(flatten_layers(acts), tables)
+        cov = {}
+        for mid, metric in metrics.items():
+            s, p = coded[mid] if codebook.covers(mid) else metric(acts)
+            packed = pack_bits_u32(p.reshape((p.shape[0], -1)))
+            cov[mid] = (s, jnp.where(mask[:, None], packed, jnp.uint32(0)))
+        return pred, unc, cov
+
+    return member_chain
+
+
 def make_group_chain_fn(
     model_def,
     layer_ids: Sequence,
     metrics: Dict[str, object],
     quantifiers: Optional[Dict] = None,
     int8_profiles: bool = False,
+    member_tables: bool = False,
 ):
     """The chain vmapped over a leading G-run ensemble-group axis.
 
-    ``(stacked_params, x, valid) -> (pred [G,B], unc {name: [G,B]}, cov
-    {mid: ([G,B], [G,B,W])})`` — one dispatch scores a whole device-resident
-    run group against the same badge (parallel/ensemble.py's stacked-params
-    layout).
+    Default (shared metrics): ``(stacked_params, x, valid) -> (pred [G,B],
+    unc {name: [G,B]}, cov {mid: ([G,B], [G,B,W])})`` — one dispatch scores
+    a whole device-resident run group against the same badge
+    (parallel/ensemble.py's stacked-params layout). All members share the
+    closed-over metric constants; right for ensembles that share train
+    statistics.
+
+    ``member_tables=True`` is the load-bearing study shape: members are
+    INDEPENDENTLY trained runs whose threshold tables differ, so the
+    signature grows two inputs — ``(stacked_params, tables, x, valid,
+    members) -> ...`` where ``tables`` is a ``ThresholdCodebook.table``
+    triple stacked to [G, N, K] per component, and ``members`` is a TRACED
+    int32 member-valid scalar: when the run count is not a multiple of G
+    the engine pads the stack (repeating member 0) and members at index >=
+    ``members`` get all-zero packed profiles — inert to any downstream CAM
+    consumer, same contract as badge-padding rows — so ONE compiled shape
+    serves the ragged tail.
     """
     import jax
+    import jax.numpy as jnp
 
-    chain = make_chain_fn(
-        model_def,
-        layer_ids,
-        metrics,
-        quantifiers=quantifiers,
-        int8_profiles=int8_profiles,
+    if not member_tables:
+        chain = make_chain_fn(
+            model_def,
+            layer_ids,
+            metrics,
+            quantifiers=quantifiers,
+            int8_profiles=int8_profiles,
+        )
+        return jax.vmap(chain, in_axes=(0, None, None))
+
+    member = make_member_chain_fn(
+        model_def, layer_ids, metrics, quantifiers=quantifiers
     )
-    return jax.vmap(chain, in_axes=(0, None, None))
+    vmapped = jax.vmap(member, in_axes=(0, 0, None, None))
+
+    def group_chain(stacked_params, tables, xb, valid, members):
+        pred, unc, cov = vmapped(stacked_params, tables, xb, valid)
+        alive = jnp.arange(pred.shape[0]) < members
+        cov = {
+            mid: (s, jnp.where(alive[:, None, None], packed, jnp.uint32(0)))
+            for mid, (s, packed) in cov.items()
+        }
+        return pred, unc, cov
+
+    return group_chain
 
 
 def select_top_k(values, valid, k: int):
@@ -284,6 +409,20 @@ def make_select_fn(k: int):
         return select_top_k(values, valid, k)
 
     return select
+
+
+def make_group_select_fn(k: int):
+    """``(values [G, N], valid) -> [G, k]`` — ``select_top_k`` vmapped over
+    the group axis with ``k`` closed over. Members score the same badge, so
+    the badge-padding ``valid`` scalar is shared; each member's row keeps
+    the exact ``make_select_fn`` tie policy (stable ascending argsort,
+    best-last)."""
+    import jax
+
+    def select(values, valid):
+        return select_top_k(values, valid, k)
+
+    return jax.vmap(select, in_axes=(0, None))
 
 
 def rank_badges(badges):

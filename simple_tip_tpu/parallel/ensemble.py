@@ -58,6 +58,24 @@ def stack_init(model, seeds: List[int], example_x) -> dict:
     return jax.vmap(one)(jnp.asarray(seeds, dtype=jnp.uint32))
 
 
+def stack_params(params_list):
+    """Stack per-member parameter pytrees into ONE pytree with a leading
+    member axis — the canonical host-side stacker.
+
+    This is the inverse of ``unstack`` and the layout both ``train_ensemble``
+    and the grouped study executor (``engine/run_program.GroupChainRunner``)
+    speak: leaf ``[G, ...]`` with member g at index g. ``np.stack`` on the
+    host preserves leaf dtypes exactly (a bf16 checkpoint stays bf16 — no
+    silent upcast doubling the stacked-weights HBM residency).
+    """
+    if not params_list:
+        raise ValueError("stack_params needs at least one member")
+    return jax.tree.map(
+        lambda *leaves: np.stack([np.asarray(l) for l in leaves]),
+        *params_list,
+    )
+
+
 def unstack(stacked, i: int):
     """Extract member ``i``'s parameters from a stacked pytree (host copy)."""
     return jax.tree.map(lambda leaf: np.asarray(leaf[i]), stacked)

@@ -228,6 +228,11 @@ PHASES = {
 _SYNTHETIC_PHASES = frozenset(p for p in PHASES if p.startswith("_test_"))
 
 
+def _unit_members(unit) -> List[int]:
+    """Model ids inside one work unit (scalar id, or a G-id group tuple)."""
+    return list(unit) if isinstance(unit, (tuple, list)) else [unit]
+
+
 def _worker_main(case_study, phase, work_q, done_q, stop_event, phase_kwargs, env_overrides):
     """Entry point of one spawned worker process."""
     os.environ.update(env_overrides)
@@ -266,8 +271,11 @@ def _worker_main(case_study, phase, work_q, done_q, stop_event, phase_kwargs, en
             # through a feeder thread, so an early get_nowait can see Empty
             # before already-put ids reach the pipe and silently strand them.
             # The stop event — set by the scheduler only once every id has
-            # resolved — is the exit signal.
-            model_id = work_q.get(timeout=0.5)
+            # resolved — is the exit signal. A unit is either one model id
+            # or a G-id group tuple (cross-run dispatch fusion): the phase
+            # fn receives all its ids in ONE call so the grouped chain can
+            # score them per-dispatch.
+            unit = work_q.get(timeout=0.5)
         except queue_mod.Empty:
             if stop_event.is_set():
                 # Explicit flush (not only atexit): the scheduler may
@@ -275,28 +283,34 @@ def _worker_main(case_study, phase, work_q, done_q, stop_event, phase_kwargs, en
                 obs.flush_metrics()
                 return
             continue
+        ids = _unit_members(unit)
         # Announce the claim so the scheduler can detect a wedged/killed
-        # worker holding this id and requeue it.
-        done_q.put(("start", model_id, os.getpid()))
+        # worker holding this unit and requeue it.
+        done_q.put(("start", unit, os.getpid()))
         try:
             # Env-plan chaos seam: a TIP_FAULT_PLAN "worker.run" fault
             # kills, wedges or errors this attempt AFTER the claim is
             # announced — the shape of a real mid-run worker loss, for
-            # any phase (error kinds report as per-id failures).
-            faults.maybe_inject("worker.run", phase=phase, model_id=model_id)
+            # any phase (error kinds report as per-id failures). Fired per
+            # member so a plan matching any grouped id still triggers.
+            for model_id in ids:
+                faults.maybe_inject("worker.run", phase=phase, model_id=model_id)
+            span_kw = (
+                {"model_id": ids[0]} if len(ids) == 1 else {"model_ids": ids}
+            )
             with obs.span(
-                "run", phase=phase, case_study=case_study, model_id=model_id
+                "run", phase=phase, case_study=case_study, **span_kw
             ):
-                fn(cs, [model_id], **phase_kwargs)
-            done_q.put(("done", model_id, None))
+                fn(cs, ids, **phase_kwargs)
+            done_q.put(("done", unit, None))
         except (KeyboardInterrupt, SystemExit) as e:
-            # Report the interrupted id, then actually stop — an interrupted
-            # worker must not keep draining the queue.
-            done_q.put(("done", model_id, repr(e)))
+            # Report the interrupted unit, then actually stop — an
+            # interrupted worker must not keep draining the queue.
+            done_q.put(("done", unit, repr(e)))
             obs.flush_metrics()
             raise
         except BaseException as e:  # noqa: BLE001 — reported; scheduler decides
-            done_q.put(("done", model_id, repr(e)))
+            done_q.put(("done", unit, repr(e)))
         obs.record_device_memory()
 
 
@@ -323,8 +337,18 @@ def run_phase_parallel(
     worker_platforms: Optional[List[str]] = None,
     run_timeout_s: Optional[float] = None,
     fleet=None,
+    group_size: int = 1,
 ) -> None:
     """Run ``phase`` for ``model_ids`` across ``num_workers`` processes.
+
+    ``group_size > 1`` makes the work unit a TUPLE of up to G model ids
+    instead of a single id (cross-run dispatch fusion: the phase fn gets
+    all of a unit's ids in one call, so the grouped chain runner scores
+    them per-dispatch). Journaling, fencing and the failure report stay at
+    MODEL granularity: journaled members are filtered out BEFORE units are
+    formed — a resumed phase replays only a group's unjournaled members —
+    and in fleet mode each member carries its own lease/fence token, so a
+    lost lease discards exactly that member's commit, never the group's.
 
     ``run_timeout_s`` bounds one id's attempt on one worker (default env
     ``TIP_RUN_TIMEOUT_S``, 3600): past it the worker is presumed wedged in a
@@ -374,7 +398,19 @@ def run_phase_parallel(
         for m in skipped:
             obs.event("scheduler.skip_journaled", model_id=m, phase=phase)
 
-    num_workers = max(1, min(num_workers, max(1, len(pending))))
+    # Group units form AFTER the journal filter: a resumed mid-group run
+    # re-chunks only the unjournaled members (exactly-once at model
+    # granularity — acceptance-pinned in tests/test_run_scheduler.py).
+    group_size = max(1, int(group_size))
+    if group_size > 1:
+        units = [
+            tuple(pending[i : i + group_size])
+            for i in range(0, len(pending), group_size)
+        ]
+    else:
+        units = list(pending)
+
+    num_workers = max(1, min(num_workers, max(1, len(units))))
     if worker_platforms is None:
         worker_platforms = ["default"] * num_workers
 
@@ -463,9 +499,10 @@ def run_phase_parallel(
     done_q = ctx.Queue()
     stop_event = ctx.Event()
     if fleet is None:
-        for m in pending:
-            work_q.put(m)
-            obs.event("scheduler.announce", model_id=m, phase=phase)
+        for u in units:
+            work_q.put(u)
+            for m in _unit_members(u):
+                obs.event("scheduler.announce", model_id=m, phase=phase)
     # Fleet mode enqueues nothing up front: an id reaches work_q only once
     # THIS host wins its lease (see _fleet_tick below), so two members
     # sharing a phase partition the ids instead of both running all of them.
@@ -507,8 +544,8 @@ def run_phase_parallel(
     # Journal-skipped ids are pre-resolved successes; everything below
     # (the progress loop, the final failure report) sees them as done.
     results: Dict[int, Optional[str]] = {m: None for m in skipped}
-    in_flight: Dict[int, Dict] = {}  # id -> {"pid", "deadline"}
-    requeues: Dict[int, int] = {}  # id -> requeue count so far
+    in_flight: Dict = {}  # unit (id or id-tuple) -> {"pid", "deadline"}
+    requeues: Dict = {}  # unit -> requeue count so far
 
     # Fleet-mode state. ``claimed`` holds the fence token for every id whose
     # lease THIS host currently owns (renewed each tick, presented at the
@@ -574,6 +611,7 @@ def run_phase_parallel(
         for m, err in failed_else.items():
             if m not in results and m not in claimed and m not in done_elsewhere:
                 failed_elsewhere[m] = err
+        new_claims: List[int] = []
         for m in pending:
             if (
                 m in results
@@ -586,8 +624,16 @@ def run_phase_parallel(
             if tok is None:
                 continue  # leased to (or failed on) another member
             claimed[m] = tok
-            work_q.put(m)
-            obs.event("scheduler.announce", model_id=m, phase=phase)
+            new_claims.append(m)
+        # Chunk this tick's winnings into group units (ragged tail flushes
+        # same tick — every sweep covers all pending ids, so holding a
+        # partial group back could strand it). Each member keeps its OWN
+        # fence token; only the dispatch unit is grouped.
+        for i in range(0, len(new_claims), group_size):
+            chunk = new_claims[i : i + group_size]
+            work_q.put(tuple(chunk) if group_size > 1 else chunk[0])
+            for m in chunk:
+                obs.event("scheduler.announce", model_id=m, phase=phase)
         for m, tok in list(claimed.items()):
             if m in results:
                 continue
@@ -601,94 +647,114 @@ def run_phase_parallel(
                 obs.counter("lease.lost_renewals").inc()
 
     def _handle(msg) -> None:
-        kind, model_id, payload = msg
+        kind, unit, payload = msg
         if kind == "start":
             # Deadlines ride the monotonic clock: an NTP step mid-run must
             # not fire (or indefinitely defer) a wedge timeout.
-            in_flight[model_id] = {
+            in_flight[unit] = {
                 "pid": payload,
                 "deadline": time.monotonic() + run_timeout_s,
             }
-            obs.event(
-                "scheduler.start", model_id=model_id, phase=phase,
-                worker_pid=payload,
-            )
+            for model_id in _unit_members(unit):
+                obs.event(
+                    "scheduler.start", model_id=model_id, phase=phase,
+                    worker_pid=payload,
+                )
             return
-        in_flight.pop(model_id, None)
-        if model_id in results:
-            return  # late duplicate after a requeue race; first report wins
-        if fleet is not None:
+        in_flight.pop(unit, None)
+        # A unit reports once, but members RESOLVE individually: journal
+        # marks, fence commits and the failure report all stay at model
+        # granularity so grouped dispatch never widens the exactly-once
+        # unit.
+        for model_id in _unit_members(unit):
+            if model_id in results:
+                continue  # late duplicate after a requeue race; first wins
+            if fleet is not None:
+                if payload is None:
+                    # Fenced commit: the journal is the single commit
+                    # point. A host whose lease was stolen mid-run (expired
+                    # while wedged, speculative re-lease of a straggler) is
+                    # rejected HERE — its finished work is discarded, the
+                    # stealer's commit stands, and every member lands in
+                    # the journal exactly once. Only THIS member's commit
+                    # is discarded; its group-mates' leases stand on their
+                    # own tokens.
+                    tok = claimed.pop(model_id, None)
+                    try:
+                        if tok is None:
+                            raise LeaseLost(
+                                f"no live lease held for run {model_id}"
+                            )
+                        journal.mark_done(model_id, fence=tok)
+                    except LeaseLost as e:
+                        obs.counter("lease.fence_rejects").inc()
+                        obs.event(
+                            "scheduler.fence_reject", model_id=model_id,
+                            phase=phase, error=str(e)[:200],
+                        )
+                        logger.warning(
+                            "[%s] %s: run %d finished but its lease was "
+                            "lost (%s); discarding — the stealing host owns "
+                            "this unit",
+                            case_study, phase, model_id, e,
+                        )
+                        continue
+                    fleet.release(tok)
+                    results[model_id] = None
+                    logger.info(
+                        "[%s] %s: run %d done", case_study, phase, model_id
+                    )
+                    obs.event("scheduler.done", model_id=model_id, phase=phase)
+                else:
+                    tok = claimed.pop(model_id, None)
+                    final = fleet.report_failure(model_id, tok, str(payload))
+                    if final is not None:
+                        results[model_id] = final
+                        logger.error(
+                            "[%s] %s: run %d FAILED fleet-wide: %s",
+                            case_study, phase, model_id, final,
+                        )
+                        obs.event(
+                            "scheduler.fail", model_id=model_id, phase=phase,
+                            error=str(final)[:300],
+                        )
+                    else:
+                        logger.warning(
+                            "[%s] %s: run %d failed here (%s); lease "
+                            "released for retry on another member",
+                            case_study, phase, model_id, payload,
+                        )
+                        obs.event(
+                            "scheduler.release_retry", model_id=model_id,
+                            phase=phase, error=str(payload)[:200],
+                        )
+                continue
+            results[model_id] = payload
             if payload is None:
-                # Fenced commit: the journal is the single commit point. A
-                # host whose lease was stolen mid-run (expired while wedged,
-                # speculative re-lease of a straggler) is rejected HERE — its
-                # finished work is discarded, the stealer's commit stands,
-                # and every unit lands in the journal exactly once.
-                tok = claimed.pop(model_id, None)
-                try:
-                    if tok is None:
-                        raise LeaseLost(f"no live lease held for run {model_id}")
-                    journal.mark_done(model_id, fence=tok)
-                except LeaseLost as e:
-                    obs.counter("lease.fence_rejects").inc()
-                    obs.event(
-                        "scheduler.fence_reject", model_id=model_id,
-                        phase=phase, error=str(e)[:200],
-                    )
-                    logger.warning(
-                        "[%s] %s: run %d finished but its lease was lost "
-                        "(%s); discarding — the stealing host owns this unit",
-                        case_study, phase, model_id, e,
-                    )
-                    return
-                fleet.release(tok)
-                results[model_id] = None
                 logger.info("[%s] %s: run %d done", case_study, phase, model_id)
                 obs.event("scheduler.done", model_id=model_id, phase=phase)
+                if journal is not None:
+                    journal.mark_done(model_id)
             else:
-                tok = claimed.pop(model_id, None)
-                final = fleet.report_failure(model_id, tok, str(payload))
-                if final is not None:
-                    results[model_id] = final
-                    logger.error(
-                        "[%s] %s: run %d FAILED fleet-wide: %s",
-                        case_study, phase, model_id, final,
-                    )
-                    obs.event(
-                        "scheduler.fail", model_id=model_id, phase=phase,
-                        error=str(final)[:300],
-                    )
-                else:
-                    logger.warning(
-                        "[%s] %s: run %d failed here (%s); lease released "
-                        "for retry on another member",
-                        case_study, phase, model_id, payload,
-                    )
-                    obs.event(
-                        "scheduler.release_retry", model_id=model_id,
-                        phase=phase, error=str(payload)[:200],
-                    )
-            return
-        results[model_id] = payload
-        if payload is None:
-            logger.info("[%s] %s: run %d done", case_study, phase, model_id)
-            obs.event("scheduler.done", model_id=model_id, phase=phase)
-            if journal is not None:
-                journal.mark_done(model_id)
-        else:
-            logger.error(
-                "[%s] %s: run %d FAILED: %s", case_study, phase, model_id, payload
-            )
-            obs.event(
-                "scheduler.fail", model_id=model_id, phase=phase,
-                error=str(payload)[:300],
-            )
+                logger.error(
+                    "[%s] %s: run %d FAILED: %s",
+                    case_study, phase, model_id, payload,
+                )
+                obs.event(
+                    "scheduler.fail", model_id=model_id, phase=phase,
+                    error=str(payload)[:300],
+                )
 
     def _reap_stuck() -> None:
-        """Terminate wedged/dead workers holding an id; requeue once to CPU."""
+        """Terminate wedged/dead workers holding a unit; requeue once to CPU.
+
+        A unit is reaped and requeued WHOLE (its members resolve together on
+        a worker), but the give-up path and fleet failure reporting stay
+        per member."""
         now = time.monotonic()
         by_pid = {w.pid: w for w in workers}
-        for model_id, info in list(in_flight.items()):
+        for unit, info in list(in_flight.items()):
+            members = _unit_members(unit)
             w = by_pid.get(info["pid"])
             worker_dead = w is not None and not w.is_alive()
             if now <= info["deadline"] and not worker_dead:
@@ -703,63 +769,71 @@ def run_phase_parallel(
             ).inc()
             if w is not None and w.is_alive():
                 logger.error(
-                    "[%s] %s: run %d %s — terminating worker pid %s",
-                    case_study, phase, model_id, reason, w.pid,
+                    "[%s] %s: run(s) %s %s — terminating worker pid %s",
+                    case_study, phase, members, reason, w.pid,
                 )
                 w.terminate()
-            in_flight.pop(model_id, None)
+            in_flight.pop(unit, None)
             # A reaped work_q worker leaves the main pool one short; without a
             # replacement, still-unclaimed ids on work_q would strand behind
             # the stall timeout (or be abandoned outright on a 1-worker pool).
-            outstanding = len(_outstanding()) - len(in_flight)
+            outstanding = len(_outstanding()) - sum(
+                len(_unit_members(u)) for u in in_flight
+            )
             if w is not None and worker_queue.get(w.pid) is work_q and outstanding > 1:
                 _spawn("cpu")  # reads work_q
-            if model_id in results:
+            if all(m in results for m in members):
                 continue  # a first attempt already reported; nothing to redo
-            n = requeues.get(model_id, 0)
+            n = requeues.get(unit, 0)
             if n >= max_requeues:
-                if fleet is not None:
-                    # Local budget spent: hand the unit back to the fleet.
-                    # Another member retries it (or it fails fleet-wide once
-                    # the shared attempt budget is gone).
-                    tok = claimed.pop(model_id, None)
-                    final = fleet.report_failure(model_id, tok, reason)
-                    if final is not None:
-                        results[model_id] = final
-                        logger.error(
-                            "[%s] %s: run %d FAILED fleet-wide: %s",
-                            case_study, phase, model_id, final,
-                        )
-                    else:
-                        logger.warning(
-                            "[%s] %s: run %d local requeues spent (%s); "
-                            "lease released for retry on another member",
-                            case_study, phase, model_id, reason,
-                        )
-                        obs.event(
-                            "scheduler.release_retry", model_id=model_id,
-                            phase=phase, error=reason[:200],
-                        )
-                    continue
-                spent = "once" if n == 1 else f"{n} times"
-                results[model_id] = f"{reason}; already requeued {spent} — giving up"
-                logger.error(
-                    "[%s] %s: run %d failed after %d requeue(s)",
-                    case_study, phase, model_id, n,
-                )
+                for model_id in members:
+                    if model_id in results:
+                        continue
+                    if fleet is not None:
+                        # Local budget spent: hand the member back to the
+                        # fleet. Another host retries it (or it fails
+                        # fleet-wide once the shared attempt budget is gone).
+                        tok = claimed.pop(model_id, None)
+                        final = fleet.report_failure(model_id, tok, reason)
+                        if final is not None:
+                            results[model_id] = final
+                            logger.error(
+                                "[%s] %s: run %d FAILED fleet-wide: %s",
+                                case_study, phase, model_id, final,
+                            )
+                        else:
+                            logger.warning(
+                                "[%s] %s: run %d local requeues spent (%s); "
+                                "lease released for retry on another member",
+                                case_study, phase, model_id, reason,
+                            )
+                            obs.event(
+                                "scheduler.release_retry", model_id=model_id,
+                                phase=phase, error=reason[:200],
+                            )
+                        continue
+                    spent = "once" if n == 1 else f"{n} times"
+                    results[model_id] = (
+                        f"{reason}; already requeued {spent} — giving up"
+                    )
+                    logger.error(
+                        "[%s] %s: run %d failed after %d requeue(s)",
+                        case_study, phase, model_id, n,
+                    )
             else:
-                requeues[model_id] = n + 1
+                requeues[unit] = n + 1
                 logger.warning(
-                    "[%s] %s: requeueing run %d onto a fresh CPU-pinned worker "
-                    "(%s; attempt %d/%d)",
-                    case_study, phase, model_id, reason, n + 2, max_requeues + 1,
+                    "[%s] %s: requeueing run(s) %s onto a fresh CPU-pinned "
+                    "worker (%s; attempt %d/%d)",
+                    case_study, phase, members, reason, n + 2, max_requeues + 1,
                 )
                 obs.counter("scheduler.requeues").inc()
-                obs.event(
-                    "scheduler.requeue", model_id=model_id, phase=phase,
-                    reason=reason,
-                )
-                retry_q.put(model_id)
+                for model_id in members:
+                    obs.event(
+                        "scheduler.requeue", model_id=model_id, phase=phase,
+                        reason=reason,
+                    )
+                retry_q.put(unit)
                 _spawn("cpu", queue=retry_q)
 
     # A worker can also wedge BEFORE claiming anything (tunnel drops during

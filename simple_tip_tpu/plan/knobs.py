@@ -7,7 +7,8 @@ registry that makes that space searchable: each :class:`Knob` declares its
 env var, its **legal values** (the planner never invents a value a
 consumer would reject), its default, and **which cost-model features it
 moves** (``obs/costmodel.py`` fits ``[1, cpu?, log1p(count),
-log1p(batch)]`` per phase, divided by workers) — so ``plan/search.py``
+log1p(batch), log(group)]`` per phase, divided by workers) — so
+``plan/search.py``
 knows which knobs the learned model can actually distinguish and which it
 scores identically (those keep their default, and ``plan explain`` says
 so instead of pretending the model had an opinion).
@@ -25,7 +26,7 @@ from typing import Dict, Iterable, Optional, Tuple
 
 #: Cost-model feature names a knob may move (see ``costmodel._features``
 #: plus the ``workers`` divisor in ``predict_study``).
-FEATURES = ("platform", "batch", "workers")
+FEATURES = ("platform", "batch", "workers", "group")
 
 
 class Knob:
@@ -106,6 +107,15 @@ KNOBS: Tuple[Knob, ...] = (
         doc="whole-chain fused AOT run programs (engine/run_program.py); "
             "indistinguishable to the current cost-model features, so the "
             "default is kept unless pinned",
+    ),
+    Knob(
+        "group_size", "TIP_CHAIN_GROUP", (1, 2, 4, 8), 1,
+        doc="cross-run dispatch fusion: models scored per chain dispatch "
+            "(engine/run_program.GroupChainRunner; effective only with "
+            "fused_chain on); moves the cost model's log(group) feature "
+            "and adds ~G x param-bytes stacked-weights residency to the "
+            "device-memory constraint",
+        features=("group",), param="group",
     ),
     Knob(
         "max_badge", "TIP_SERVE_MAX_BADGE", (256, 512, 1024, 2048), 2048,
@@ -224,7 +234,7 @@ def prediction_params(assignment: dict, platform=None) -> dict:
     and ``plan explain`` use, so a plan's stored predictions are exactly
     what scoring saw.
     """
-    params = {"platform": platform, "workers": 1, "batch": None}
+    params = {"platform": platform, "workers": 1, "batch": None, "group": 1}
     for k in KNOBS:
         if k.name in assignment:
             params.update(k.prediction_overrides(assignment[k.name]))

@@ -42,12 +42,19 @@ class InfeasiblePlan(RuntimeError):
 
 
 def fit_memory_model(rows, min_rows: int = costmodel.DEFAULT_MIN_ROWS):
-    """Peak-device-bytes model (``peak ~ a + b*batch``) from the corpus.
+    """Peak-bytes model (``peak ~ a + b*batch + c*(group-1)``) from rows.
 
     Trains on non-degraded rows carrying both ``device_peak_bytes`` and
-    ``batch``. Returns ``{coef, n, max_peak_bytes}`` or None when fewer
-    than ``min_rows`` rows qualify — the caller decides whether None is
-    fatal (it is, whenever a capacity bound was requested).
+    ``batch``; a row's ``group`` (cross-run dispatch-fusion group size,
+    absent on pre-group corpora) enters as ``group - 1`` so the ungrouped
+    baseline contributes zero and a corpus with no grouped rows fits the
+    exact pre-group model (the ridge pins the dead column to ~0). The
+    ``c`` coefficient is the *measured* stacked-weights residency per
+    extra group member — learned from telemetry, not computed from param
+    counts, so it prices whatever the runtime actually holds resident.
+    Returns ``{coef, n, max_peak_bytes}`` or None when fewer than
+    ``min_rows`` rows qualify — the caller decides whether None is fatal
+    (it is, whenever a capacity bound was requested).
     """
     obs = []
     for row in rows:
@@ -56,33 +63,47 @@ def fit_memory_model(rows, min_rows: int = costmodel.DEFAULT_MIN_ROWS):
         if row.get("degraded") is True:
             continue
         if isinstance(peak, (int, float)) and isinstance(batch, (int, float)):
-            obs.append((float(batch), float(peak)))
+            group = row.get("group")
+            g = float(group) if isinstance(group, (int, float)) else 1.0
+            obs.append((float(batch), max(g, 1.0), float(peak)))
     if len(obs) < min_rows:
         return None
     try:
         coef = costmodel._least_squares(
-            [[1.0, b] for b, _p in obs], [p for _b, p in obs]
+            [[1.0, b, g - 1.0] for b, g, _p in obs],
+            [p for _b, _g, p in obs],
         )
     except ValueError:
         return None
     return {
         "coef": [round(c, 6) for c in coef],
         "n": len(obs),
-        "max_peak_bytes": int(max(p for _b, p in obs)),
+        "max_peak_bytes": int(max(p for _b, _g, p in obs)),
     }
 
 
-def predict_peak_bytes(mem_model: dict, batch) -> int:
-    """Predicted device peak bytes at ``batch`` under ``mem_model``.
+def predict_peak_bytes(mem_model: dict, batch, group=1) -> int:
+    """Predicted device peak bytes at ``(batch, group)`` under the model.
 
-    A non-increasing fit (noise, constant-batch corpus) falls back to the
-    max observed peak — constant but conservative, never extrapolating a
-    negative slope into "bigger batches are free".
+    A non-increasing batch fit (noise, constant-batch corpus) falls back
+    to the max observed peak — constant but conservative, never
+    extrapolating a negative slope into "bigger batches are free". The
+    group term is additive ON TOP of that base and only applied when its
+    learned coefficient is positive: a noisy negative ``c`` must never
+    let a bigger G *discount* the predicted peak below the ungrouped
+    baseline, because an over-capacity G is a dead study, not a slow one.
     """
-    a, b = mem_model["coef"]
+    coef = mem_model["coef"]
+    a, b = coef[0], coef[1]
+    c = coef[2] if len(coef) > 2 else 0.0
     if b <= 0 or batch is None:
-        return mem_model["max_peak_bytes"]
-    return int(max(a + b * float(batch), mem_model["max_peak_bytes"] * 0.0))
+        base = float(mem_model["max_peak_bytes"])
+    else:
+        base = max(a + b * float(batch), 0.0)
+    extra = 0.0
+    if c > 0:
+        extra = c * (max(float(group or 1), 1.0) - 1.0)
+    return int(base + extra)
 
 
 def search(rows, phases, runs: int, case_studies: int = 1, platform=None,
@@ -115,12 +136,14 @@ def search(rows, phases, runs: int, case_studies: int = 1, platform=None,
         pred = costmodel.predict_study(
             model, phases, runs, case_studies,
             platform=params["platform"], workers=params["workers"],
-            batch=params["batch"],
+            batch=params["batch"], group=params.get("group"),
         )
         peak = None
         rejected = False
         if mem_model is not None:
-            peak = predict_peak_bytes(mem_model, params["batch"])
+            peak = predict_peak_bytes(
+                mem_model, params["batch"], params.get("group") or 1
+            )
             rejected = peak > capacity_bytes
         return pred, peak, rejected
 

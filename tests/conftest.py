@@ -54,7 +54,7 @@ for _var in [v for v in os.environ if v.startswith("TIP_SERVE_")] + [
 # test through the AOT program layer (and a developer's program-cache dir
 # would leak compiled executables across suites); the fused path is opted
 # into per-test.
-for _var in ["TIP_FUSED_CHAIN", "TIP_INT8_PROFILES"] + [
+for _var in ["TIP_FUSED_CHAIN", "TIP_INT8_PROFILES", "TIP_CHAIN_GROUP"] + [
     v for v in os.environ if v.startswith("TIP_PROGRAM_CACHE")
 ]:
     os.environ.pop(_var, None)

@@ -4,11 +4,13 @@ and shards correctly across the mesh (the fake-cluster test the reference
 never had)."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from simple_tip_tpu.models import MnistConvNet
 from simple_tip_tpu.models.train import TrainConfig, evaluate_accuracy
 from simple_tip_tpu.parallel import ensemble_mesh, stack_init, train_ensemble, unstack
+from simple_tip_tpu.parallel.ensemble import stack_params
 from tests.test_model import _toy_data
 
 
@@ -25,6 +27,45 @@ def test_stack_init_members_differ():
     p0, p1 = unstack(stacked, 0), unstack(stacked, 1)
     diffs = jax.tree.map(lambda a, b: np.abs(a - b).max(), p0, p1)
     assert max(jax.tree.leaves(diffs)) > 0
+
+
+def test_stack_params_round_trips_members():
+    """``stack_params`` is the canonical checkpoint stacker: member g of
+    the stack unstacks back to the exact input pytree (the layout contract
+    engine/run_program.GroupChainRunner stages onto the device)."""
+    model = MnistConvNet(num_classes=4)
+    x = np.zeros((1, 28, 28, 1), np.float32)
+    members = [unstack(stack_init(model, [s], x), 0) for s in (0, 1, 2)]
+    stacked = stack_params(members)
+    leaf = jax.tree.leaves(stacked)[0]
+    assert leaf.shape[0] == 3
+    for g, p in enumerate(members):
+        got = unstack(stacked, g)
+        same = jax.tree.map(lambda a, b: np.array_equal(a, b), got, p)
+        assert all(jax.tree.leaves(same))
+
+
+def test_stack_params_preserves_bf16_dtype():
+    """Stacking must not silently widen member dtypes: a bf16 checkpoint
+    stacks to a bf16 leaf (G x param bytes is the device-residency cost
+    the planner's memory model prices — up-casting would double it)."""
+    member = {
+        "dense": {
+            "kernel": jnp.ones((4, 2), jnp.bfloat16),
+            "bias": np.zeros((2,), np.float32),
+        }
+    }
+    stacked = stack_params([member, member])
+    assert stacked["dense"]["kernel"].shape == (2, 4, 2)
+    assert stacked["dense"]["kernel"].dtype == jnp.bfloat16
+    assert stacked["dense"]["bias"].dtype == np.float32
+
+
+def test_stack_params_rejects_empty():
+    import pytest
+
+    with pytest.raises(ValueError, match="at least one"):
+        stack_params([])
 
 
 def test_train_ensemble_learns_on_mesh():

@@ -24,6 +24,8 @@ from simple_tip_tpu.ops.fused_chain import (
     ThresholdCodebook,
     make_chain_fn,
     make_group_chain_fn,
+    make_group_select_fn,
+    make_member_chain_fn,
     make_select_fn,
     pack_bits_u32,
     rank_badges,
@@ -137,6 +139,114 @@ def test_group_chain_matches_per_member(tiny_setup):
             np.testing.assert_array_equal(
                 np.asarray(g_cov[mid][1][g]), np.asarray(cov[mid][1])
             )
+
+
+def _member_metrics(model, params, x_train):
+    """The fixture's metric set built from ONE member's own train stats."""
+    _, taps = model.apply({"params": params}, jnp.asarray(x_train), train=False)
+    flat = flatten_layers([np.asarray(taps[i]) for i in LAYERS])
+    mins, maxs = [flat.min(axis=0)], [flat.max(axis=0)]
+    stds = [flat.std(axis=0)]
+    return {
+        "NAC_0": NAC(cov_threshold=0.0),
+        "NAC_0.75": NAC(cov_threshold=0.75),
+        "NBC_0.5": NBC(mins=mins, maxs=maxs, stds=stds, scaler=0.5),
+        "SNAC_0": SNAC(maxs=maxs, stds=stds, scaler=0.0),
+        "KMNC_2": KMNC(mins, maxs, sections=2),
+        "TKNC_2": TKNC(top_neurons=2),
+    }
+
+
+def test_member_tables_group_chain_matches_per_member(tiny_setup):
+    """member_tables=True parity: per-member thresholds ride as traced
+    inputs, so ONE program built from member 0's metric STRUCTURE must
+    reproduce each member's own-thresholds chain bit-for-bit."""
+    model, params, x_test, metrics, _ = tiny_setup
+    rng = np.random.RandomState(23)
+    x_train2 = rng.rand(48, 12, 12, 1).astype(np.float32)
+    params2 = init_params(model, jax.random.PRNGKey(11), x_test[:2])
+    metrics2 = _member_metrics(model, params2, x_train2)
+    member_sets = [(params, metrics), (params2, metrics2)]
+
+    cbs = [ThresholdCodebook(m) for _p, m in member_sets]
+    assert cbs[0].spec_signature() == cbs[1].spec_signature()
+    _, taps = model.apply(
+        {"params": params}, jnp.asarray(x_test[:1]), train=False
+    )
+    n_neurons = flatten_layers([np.asarray(taps[i]) for i in LAYERS]).shape[1]
+    tables = tuple(
+        jnp.asarray(np.stack([cb.table(n_neurons)[i] for cb in cbs]))
+        for i in range(3)
+    )
+
+    group = jax.jit(
+        make_group_chain_fn(model, LAYERS, metrics, member_tables=True)
+    )
+    stacked = jax.tree_util.tree_map(
+        lambda a, b: jnp.stack([a, b]), params, params2
+    )
+    xb = jnp.asarray(x_test)
+    valid = np.int32(len(x_test))
+    g_pred, g_unc, g_cov = group(stacked, tables, xb, valid, np.int32(2))
+
+    for g, (p, m) in enumerate(member_sets):
+        member = make_member_chain_fn(model, LAYERS, m)
+        m_tables = tuple(t[g] for t in tables)
+        pred, unc, cov = jax.jit(member)(p, m_tables, xb, valid)  # tiplint: disable=retrace-risk (one-shot per-test compile)
+        np.testing.assert_array_equal(np.asarray(g_pred[g]), np.asarray(pred))
+        for name in unc:
+            np.testing.assert_array_equal(
+                np.asarray(g_unc[name][g]), np.asarray(unc[name])
+            )
+        for mid in metrics:
+            np.testing.assert_array_equal(
+                np.asarray(g_cov[mid][0][g]), np.asarray(cov[mid][0])
+            )
+            np.testing.assert_array_equal(
+                np.asarray(g_cov[mid][1][g]), np.asarray(cov[mid][1])
+            )
+
+    # Ragged tail: with members=1 the pad member's packed profiles are
+    # all-zero (inert to CAM), member 0 is bit-identical to members=2.
+    r_pred, _r_unc, r_cov = group(stacked, tables, xb, valid, np.int32(1))
+    np.testing.assert_array_equal(np.asarray(r_pred[0]), np.asarray(g_pred[0]))
+    for mid in metrics:
+        packed = np.asarray(r_cov[mid][1])
+        assert not packed[1].any(), f"{mid}: pad member has set bits"
+        np.testing.assert_array_equal(packed[0], np.asarray(g_cov[mid][1][0]))
+
+
+def test_member_tables_match_baked_constant_apply(tiny_setup):
+    """``apply_tables`` with host-precast f32 tables == the baked-constant
+    ``apply`` path, bit for bit — the precondition for swapping constants
+    out for traced inputs without perturbing a single profile."""
+    model, params, x_test, metrics, taps_of = tiny_setup
+    _, acts = taps_of(x_test)
+    flat = jnp.asarray(flatten_layers(acts))
+    cb = ThresholdCodebook(metrics)
+    baked = jax.jit(cb.apply)(flat)  # tiplint: disable=retrace-risk (one-shot per-test compile)
+    tables = tuple(jnp.asarray(t) for t in cb.table(int(flat.shape[1])))
+    traced = jax.jit(cb.apply_tables)(flat, tables)  # tiplint: disable=retrace-risk (one-shot per-test compile)
+    for mid in baked:
+        np.testing.assert_array_equal(
+            np.asarray(traced[mid][0]), np.asarray(baked[mid][0])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(traced[mid][1]), np.asarray(baked[mid][1])
+        )
+
+
+def test_group_select_matches_per_member_select():
+    """The vmapped group select keeps each member's exact tie policy."""
+    rng = np.random.RandomState(21)
+    vals = rng.rand(3, 20).astype(np.float32)
+    vals[:, 3] = vals[:, 7]  # force a tie inside the valid range
+    sel = jax.jit(make_group_select_fn(4))
+    got = np.asarray(sel(jnp.asarray(vals), np.int32(17)))
+    for g in range(3):
+        want = np.asarray(select_top_k(jnp.asarray(vals[g]), np.int32(17), 4))
+        np.testing.assert_array_equal(got[g], want)
+        assert (got[g] < 17).all()
 
 
 def test_rank_badges_matches_device_cam(tiny_setup):

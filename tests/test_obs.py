@@ -931,6 +931,70 @@ def test_store_builds_schema_stamped_index(tmp_path):
     assert any(r["value"] == pytest.approx(3150000.0) for r in t01)
 
 
+def test_store_parses_grouped_chain_sweep_to_group_rows(tmp_path):
+    """The bench grouped-chain companion lands as GROUP-FEATURED feature
+    rows: one grouped_chain.walk row per swept G (count = G x inputs, so
+    seconds/count is per-MODEL-input — the signal the planner's
+    coordinate descent ranks TIP_CHAIN_GROUP with), plus value rows for
+    dispatches/badge and the analytic host-bytes claim."""
+    from simple_tip_tpu.obs import store
+
+    bench = tmp_path / "BENCH_r42.json"
+    bench.write_text(json.dumps({
+        "metric": "m", "value": 5.0, "platform": "tpu", "batch": 64,
+        "grouped_chain": {
+            "group_sizes": [1, 2], "n_inputs": 512, "badge_size": 256,
+            "n_metrics": 12, "host_bytes_per_input": 68,
+            "sweep": {
+                "1": {"models_per_dispatch": 1, "walk_seconds": 0.8,
+                      "inputs_per_sec": 640.0, "chain_dispatches": 2,
+                      "dispatches_per_badge": 1.0},
+                "2": {"models_per_dispatch": 2, "walk_seconds": 0.9,
+                      "inputs_per_sec": 1137.8, "chain_dispatches": 2,
+                      "dispatches_per_badge": 1.0},
+            },
+        },
+    }))
+    rows = store._rows_from_bench(str(bench), 1)
+    walk = {r["group"]: r for r in rows if r["phase"] == "grouped_chain.walk"}
+    assert set(walk) == {1, 2}
+    assert walk[2]["count"] == 2 * 512 and walk[2]["seconds"] == 0.9
+    assert walk[2]["batch"] == 256  # badge size, not the bench batch
+    claim = [r for r in rows
+             if r["phase"] == "grouped_chain.host_bytes_per_input"]
+    assert claim and claim[0]["value"] == 68.0
+    dpb = [r for r in rows
+           if r["phase"] == "grouped_chain.dispatches_per_badge"]
+    assert {r["group"] for r in dpb} == {1, 2}
+    assert all(r["value"] == 1.0 for r in dpb)
+
+
+def test_regress_gates_host_bytes_per_input_claims(tmp_path):
+    """fused_chain/grouped_chain host-bytes-per-input surface as gated
+    phases: growing the per-input host traffic >25% (e.g. a fan-out that
+    starts draining packed profiles) fails the regress gate."""
+    from simple_tip_tpu.obs.regress import compare, load_snapshot
+
+    def _snap(path, fused_bytes, grouped_bytes):
+        path.write_text(json.dumps({
+            "metric": "m", "value": 5.0,
+            "fused_chain": {"host_transfer_bytes_per_input": fused_bytes},
+            "grouped_chain": {"host_bytes_per_input": grouped_bytes},
+        }))
+        return load_snapshot(str(path))
+
+    base = _snap(tmp_path / "base.json", 68, 68)
+    assert base["phases"]["fused_chain.host_bytes_per_input"] == 68.0
+    assert base["phases"]["grouped_chain.host_bytes_per_input"] == 68.0
+    same = compare(base, _snap(tmp_path / "same.json", 68, 68))
+    assert same["ok"]
+    worse = compare(base, _snap(tmp_path / "worse.json", 68, 196))
+    assert not worse["ok"]
+    bad = [r for r in worse["rows"]
+           if r["name"] == "grouped_chain.host_bytes_per_input"]
+    assert bad and bad[0]["regressed"]
+
+
 def test_store_refresh_is_incremental(tmp_path):
     from simple_tip_tpu.obs import store
 

@@ -10,6 +10,7 @@ candidate predicted over memory capacity never wins; a thin corpus exits
 """
 
 import json
+import math
 import os
 
 import pytest
@@ -57,10 +58,15 @@ def test_knob_registry_is_typed_and_validating():
 
 def test_prediction_params_fold_knob_effects():
     params = knobs.prediction_params(
-        {"workers": 4, "batch": 2048, "cluster_backend": "sklearn"},
+        {"workers": 4, "batch": 2048, "cluster_backend": "sklearn",
+         "group_size": 4},
         platform="tpu",
     )
-    assert params == {"platform": "cpu", "workers": 4, "batch": 2048}
+    assert params == {"platform": "cpu", "workers": 4, "batch": 2048,
+                      "group": 4}
+    # group_size absent (pre-group assignment): baseline group=1
+    legacy = knobs.prediction_params({"workers": 1}, platform="tpu")
+    assert legacy["group"] == 1
 
 
 # --- search -----------------------------------------------------------------
@@ -108,6 +114,69 @@ def test_every_candidate_over_capacity_is_infeasible():
     with pytest.raises(search.InfeasiblePlan):
         search.search(_corpus(), ["test_prio"], runs=10, platform="tpu",
                       capacity_bytes=1024)
+
+
+def _grouped_corpus():
+    """Synthetic corpus where the grouped chain walk gets cheaper per run
+    as G grows (seconds = 0.5 - 0.02*ln(G)) and the device peak prices the
+    stacked weights (peak = 1MB + 100*batch + 500KB per extra member)."""
+    rows = []
+    for batch, group in [
+        (2048, 1), (8192, 1), (32768, 1), (2048, 2), (2048, 4), (2048, 8),
+    ]:
+        rows.append({
+            "phase": "grouped_chain.walk", "count": 1,
+            "seconds": 0.5 - 0.02 * math.log(group),
+            "platform": "tpu", "batch": batch, "group": group,
+            "degraded": False,
+            "device_peak_bytes": 1_000_000 + 100 * batch + 500_000 * (group - 1),
+        })
+    return rows
+
+
+def test_search_ranks_group_size_from_grouped_rows():
+    """Corpus rows carrying ``group`` teach the G-vs-throughput slope: the
+    unconstrained search elects the largest configured group size."""
+    result = search.search(_grouped_corpus(), ["grouped_chain.walk"],
+                           runs=10, platform="tpu")
+    assert result["assignment"]["group_size"] == 8
+    report = result["search"]["knobs"]["group_size"]
+    assert report["env"] == "TIP_CHAIN_GROUP"
+    totals = [report["values"][str(g)]["total_s"] for g in (1, 2, 4, 8)]
+    assert totals == sorted(totals, reverse=True), (
+        "predicted study time must fall monotonically with G on this corpus"
+    )
+
+
+def test_memory_rejection_caps_group_size():
+    """Stacked weights are G x param bytes on the device: a capacity bound
+    the learned peak model prices must reject over-capacity G outright
+    (an OOM'd group is a dead study, not a slow one)."""
+    capped = search.search(_grouped_corpus(), ["grouped_chain.walk"],
+                           runs=10, platform="tpu",
+                           capacity_bytes=2_000_000)
+    # peak(G) at batch=2048: 1.70MB @ G=2 fits, 2.70MB @ G=4 does not
+    assert capped["assignment"]["group_size"] == 2
+    assert capped["search"]["rejected_memory"] >= 1
+    assert capped["memory"]["predicted_peak_bytes"] <= 2_000_000
+    report = capped["search"]["knobs"]["group_size"]["values"]
+    for g in ("4", "8"):
+        assert report[g]["rejected"] == "memory" and report[g]["total_s"] is None
+
+
+def test_pinned_over_capacity_group_is_infeasible():
+    with pytest.raises(search.InfeasiblePlan):
+        search.search(_grouped_corpus(), ["grouped_chain.walk"], runs=10,
+                      platform="tpu", capacity_bytes=2_000_000,
+                      pinned={"group_size": 8})
+
+
+def test_predict_peak_bytes_handles_pre_group_models():
+    """A 2-coefficient peak model from a pre-group corpus predicts exactly
+    as before, whatever group the caller asks about (c defaults to 0)."""
+    legacy = {"coef": [1000.0, 10.0], "n": 4, "max_peak_bytes": 5000}
+    assert search.predict_peak_bytes(legacy, 100, group=4) == 2000
+    assert search.predict_peak_bytes(legacy, 100) == 2000
 
 
 def test_capacity_without_peak_rows_is_insufficient_corpus():

@@ -18,7 +18,9 @@ from simple_tip_tpu.engine import eval_prioritization as ep
 from simple_tip_tpu.engine.run_program import (
     PROGRAM_FORMAT_VERSION,
     FusedChainRunner,
+    GroupChainRunner,
     ProgramCache,
+    chain_group_size,
     fused_chain_enabled,
     program_cache_max_bytes,
     program_fingerprint,
@@ -56,6 +58,20 @@ def test_fused_chain_knob(monkeypatch):
         assert fused_chain_enabled() is expect, raw
     monkeypatch.delenv("TIP_FUSED_CHAIN")
     assert fused_chain_enabled() is False
+
+
+def test_chain_group_knob(monkeypatch):
+    for raw, expect in [
+        ("", 1), ("0", 1), ("off", 1), ("OFF", 1), ("1", 1),
+        ("2", 2), ("4", 4), ("8", 8), ("-3", 1),
+    ]:
+        monkeypatch.setenv("TIP_CHAIN_GROUP", raw)
+        assert chain_group_size() == expect, raw
+    monkeypatch.delenv("TIP_CHAIN_GROUP")
+    assert chain_group_size() == 1
+    monkeypatch.setenv("TIP_CHAIN_GROUP", "two")
+    with pytest.raises(ValueError, match="TIP_CHAIN_GROUP"):
+        chain_group_size()
 
 
 def test_program_cache_max_bytes_knob(monkeypatch):
@@ -423,3 +439,199 @@ def test_fused_path_compiles_fewer_programs(tmp_path, monkeypatch):
     c = _counters()
     assert c.get("run_program.chain_dispatches", 0) >= 2
     assert c.get("run_program.rank_dispatches", 0) >= 12
+
+
+# -- grouped execution: parity, dispatch count, cache keys --------------------
+
+
+def _group_members(model, x_train, n):
+    """Member 0 reuses the fixture params; the rest are fresh inits, so
+    every member has distinct weights AND distinct training-stat
+    thresholds (the per-member codebook the grouped chain must thread)."""
+    members = [init_params(model, jax.random.PRNGKey(1), x_train[:2])]
+    for g in range(1, n):
+        members.append(init_params(model, jax.random.PRNGKey(100 + g), x_train[:2]))
+    return members
+
+
+def _assert_member_result_equal(got, ref, label):
+    np.testing.assert_array_equal(got["pred"], ref["pred"], err_msg=f"{label}: pred")
+    assert set(got["uncertainties"]) == set(ref["uncertainties"])
+    for uid in ref["uncertainties"]:
+        np.testing.assert_array_equal(
+            got["uncertainties"][uid], ref["uncertainties"][uid],
+            err_msg=f"{label}: uncertainty_{uid}",
+        )
+    assert set(got["scores"]) == set(ref["scores"])
+    for mid in ref["scores"]:
+        np.testing.assert_array_equal(
+            got["scores"][mid], ref["scores"][mid], err_msg=f"{label}: {mid} scores"
+        )
+        np.testing.assert_array_equal(
+            got["cam_orders"][mid], ref["cam_orders"][mid],
+            err_msg=f"{label}: {mid} cam_order",
+        )
+    if "al_select" in ref:
+        assert set(got["al_select"]) == set(ref["al_select"])
+        for uid in ref["al_select"]:
+            np.testing.assert_array_equal(
+                got["al_select"][uid], ref["al_select"][uid],
+                err_msg=f"{label}: al_select {uid}",
+            )
+
+
+def test_host_bytes_per_input_claim_is_68():
+    """The analytic host-transfer claim bench.py records and the regress
+    gate prices: the chain drains pred (int4-equivalent i8->i4 word) +
+    4 f32 quantifiers + one f32 score per configured metric per input —
+    4 + 16 + 12*4 = 68 bytes, per MODEL, independent of G (the grouped
+    fan-out drains the same per-member rows; packed profiles stay on
+    device). If the configured metric set changes, this pin and the
+    bench/regress constants must move together."""
+    from simple_tip_tpu.engine.coverage_handler import CoverageWorker
+    from simple_tip_tpu.engine.model_handler import BaseModel
+
+    model, params, x_train, _ = _tiny_model()
+    n_metrics = len(
+        CoverageWorker(
+            base_model=BaseModel(
+                model, params, activation_layers=LAYERS, batch_size=32
+            ),
+            training_set=x_train,
+        ).metrics
+    )
+    assert n_metrics == 12
+    assert 4 + 4 * 4 + n_metrics * 4 == 68
+
+
+def test_group_runner_matches_per_model_fused():
+    """Acceptance pin: 4 members walked at G=2 — each member's grouped
+    result (pred, every uncertainty incl. VR, scores, CAM orders, active-
+    learning selection) is byte-identical to its own per-model
+    FusedChainRunner walk with the same rng and select_k."""
+    model, _, x_train, x_test = _tiny_model()
+    members = _group_members(model, x_train, 4)
+
+    refs = []
+    for mid, p in enumerate(members):
+        runner = FusedChainRunner(
+            model, p, x_train, LAYERS, batch_size=16, badge_size=16, cache=None
+        )
+        refs.append(
+            runner.evaluate_dataset(x_test, rng=jax.random.PRNGKey(mid), select_k=5)
+        )
+
+    before = _counters().get("run_program.group_chain_dispatches", 0)
+    got = []
+    for lo in (0, 2):
+        g_runner = GroupChainRunner(
+            model, members[lo : lo + 2], x_train, LAYERS,
+            batch_size=16, badge_size=16, cache=None, group_size=2,
+        )
+        got.extend(
+            g_runner.evaluate_dataset(
+                x_test,
+                rngs=[jax.random.PRNGKey(mid) for mid in (lo, lo + 1)],
+                select_k=5,
+            )
+        )
+    # 24 inputs at badge_size=16 -> 2 badges; 2 groups -> ceil(4/2) * 2 = 4
+    # dispatches where the per-model walk above paid 4 models * 2 = 8
+    assert _counters().get("run_program.group_chain_dispatches", 0) - before == 4
+
+    assert len(got) == len(refs) == 4
+    for mid, (g, r) in enumerate(zip(got, refs)):
+        _assert_member_result_equal(g, r, f"member {mid}")
+
+
+def test_evaluate_group_matches_per_model_walk(tmp_path, monkeypatch):
+    """End-to-end grouped study walk: 5 models at G=2 (ragged tail group of
+    1) persist the byte-identical artifact set the per-model walk writes,
+    in ceil(5/2)=3 group dispatches per badge instead of 5."""
+    from simple_tip_tpu.engine.coverage_handler import CoverageWorker
+    from simple_tip_tpu.engine.model_handler import BaseModel
+
+    model, _, x_train, x_nom = _tiny_model(n_train=64, n_test=40)
+    rng = np.random.RandomState(21)
+    x_ood = rng.rand(24, 12, 12, 1).astype(np.float32)
+    y_nom = rng.randint(0, 4, size=40)
+    y_ood = rng.randint(0, 4, size=24)
+    members = _group_members(model, x_train, 5)
+    case_study = "group_parity"
+
+    metric_ids = list(
+        CoverageWorker(
+            base_model=BaseModel(model, members[0], activation_layers=LAYERS, batch_size=32),
+            training_set=x_train,
+        ).metrics
+    )
+    unc_ids = ["softmax", "pcs", "softmax_entropy", "deep_gini", "VR"]
+
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "per_model"))
+    for mid, p in enumerate(members):
+        ep._eval_fused_chain(
+            case_study, model, p, mid, LAYERS,
+            x_nom, y_nom, x_ood, y_ood, x_train, 32,
+        )
+    refs = {
+        mid: _collect_artifacts(case_study, mid, unc_ids, metric_ids)
+        for mid in range(5)
+    }
+
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "grouped"))
+    monkeypatch.setattr(ep, "_eval_surprise", lambda *a, **k: None)
+    before = _counters().get("run_program.group_chain_dispatches", 0)
+    ep.evaluate_group(
+        list(range(5)), case_study, model, lambda mid: members[mid],
+        x_train, x_nom, y_nom, x_ood, y_ood,
+        LAYERS, sa_activation_layers=[], batch_size=32, group_size=2,
+    )
+    # badge_size defaults to PROFILE_BADGE_SIZE=512, so each dataset is one
+    # badge: ceil(5/2)=3 groups x 2 datasets = 6 dispatches (vs 10 per-model)
+    assert _counters().get("run_program.group_chain_dispatches", 0) - before == 6
+
+    for mid in range(5):
+        got = _collect_artifacts(case_study, mid, unc_ids, metric_ids)
+        assert set(got) == set(refs[mid])
+        for key in refs[mid]:
+            np.testing.assert_array_equal(
+                got[key], refs[mid][key], err_msg=f"model {mid}: {key}"
+            )
+
+
+def test_program_cache_group_keys_never_collide(tmp_path, monkeypatch):
+    """Grouped fingerprints are disjoint from ungrouped ones and from each
+    other: a shared cache dir warmed by the ungrouped runner forces the
+    G=1 and G=2 runners to STORE fresh programs (a key collision would
+    load an executable traced for the wrong calling convention), while
+    G=1 grouped results stay byte-identical to the ungrouped walk."""
+    monkeypatch.setenv("TIP_PROGRAM_CACHE_DIR", str(tmp_path / "pc"))
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path / "assets"))
+    model, params, x_train, x_test = _tiny_model()
+
+    ungrouped = FusedChainRunner(
+        model, params, x_train, LAYERS, batch_size=16, badge_size=16
+    ).evaluate_dataset(x_test)
+    after_fused = dict(_counters())
+    assert after_fused.get("program_cache.store", 0) > 0
+
+    g1 = GroupChainRunner(
+        model, [params], x_train, LAYERS,
+        batch_size=16, badge_size=16, group_size=1,
+    ).evaluate_dataset(x_test)
+    after_g1 = dict(_counters())
+    assert after_g1.get("program_cache.store", 0) > after_fused.get(
+        "program_cache.store", 0
+    ), "G=1 grouped keys must not collide with ungrouped keys"
+    assert len(g1) == 1
+    _assert_member_result_equal(g1[0], ungrouped, "G=1 vs ungrouped")
+
+    members = _group_members(model, x_train, 2)
+    GroupChainRunner(
+        model, members, x_train, LAYERS,
+        batch_size=16, badge_size=16, group_size=2,
+    ).evaluate_dataset(x_test)
+    after_g2 = _counters()
+    assert after_g2.get("program_cache.store", 0) > after_g1.get(
+        "program_cache.store", 0
+    ), "G=2 keys must not collide with G=1 keys"

@@ -122,6 +122,63 @@ def test_wedged_retry_also_failing_reports_id(tmp_path):
     assert os.path.exists(os.path.join(marker_dir, "run_1.txt"))
 
 
+def test_group_unit_runs_members_on_one_worker(tmp_path):
+    """group_size=4 folds four runs into ONE work unit: a single worker
+    claims it and executes every member (the one-phase-call contract the
+    grouped chain runner needs to score G models per dispatch)."""
+    marker_dir = str(tmp_path / "markers")
+    os.makedirs(marker_dir)
+    run_phase_parallel(
+        "mnist",
+        "_test_sleep",
+        model_ids=[0, 1, 2, 3],
+        num_workers=2,
+        group_size=4,
+        phase_kwargs={"seconds": 0.05, "marker_dir": marker_dir},
+    )
+    pids = {_read_marker(marker_dir, i)[2] for i in range(4)}
+    assert len(pids) == 1, f"one group unit must run on one worker, got {pids}"
+
+
+def test_mid_group_resume_replays_only_unjournaled_members(tmp_path, monkeypatch):
+    """Exactly-once stays at MODEL granularity under grouping: members
+    journaled by a previous (interrupted) run are filtered out BEFORE
+    group units form, so a resumed phase re-chunks and replays only the
+    unjournaled members — never a whole group for one missing member."""
+    from simple_tip_tpu.resilience.journal import RunJournal
+
+    marker_dir = str(tmp_path / "markers")
+    os.makedirs(marker_dir)
+    journal_path = str(tmp_path / "journal" / "runs.jsonl")
+    os.makedirs(os.path.dirname(journal_path))
+    monkeypatch.setenv("TIP_JOURNAL", journal_path)
+
+    # The interrupted first attempt journaled members 0 and 2 (one from
+    # each of the would-be (0,1) / (2,3) groups) before dying.
+    pre = RunJournal(journal_path, "mnist", "_test_sleep")
+    pre.mark_done(0)
+    pre.mark_done(2)
+
+    run_phase_parallel(
+        "mnist",
+        "_test_sleep",
+        model_ids=[0, 1, 2, 3, 4],
+        num_workers=1,
+        group_size=2,
+        phase_kwargs={"seconds": 0.01, "marker_dir": marker_dir},
+    )
+    ran = sorted(
+        int(f[len("run_"):-len(".txt")])
+        for f in os.listdir(marker_dir)
+        if f.startswith("run_")
+    )
+    assert ran == [1, 3, 4], (
+        f"resume must replay exactly the unjournaled members, ran {ran}"
+    )
+    after = RunJournal(journal_path, "mnist", "_test_sleep")
+    assert after.completed() == {0, 1, 2, 3, 4}
+
+
 def test_unknown_phase_rejected():
     with pytest.raises(ValueError, match="unknown phase"):
         run_phase_parallel("mnist", "no_such_phase", [0], num_workers=1)

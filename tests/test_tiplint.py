@@ -846,6 +846,17 @@ def per_badge(badges):
 def one_shot(x):
     """Construct-and-call discards the compiled program immediately."""
     return jax.jit(score)(x)
+
+
+@jax.jit
+def member_unroll(stacked, x):
+    """Slicing the stacked member axis by the loop variable inside the
+    trace unrolls the group into one subgraph per member."""
+    outs = []
+    for g in range(4):
+        member = jax.tree.map(lambda leaf: leaf[g], stacked)
+        outs.append(jnp.sum(member["w"] * x))
+    return jnp.stack(outs)
 '''
 }
 
@@ -887,6 +898,22 @@ def decorated_in_loop(badges):
 
         outs.append(fn(b))
     return outs
+
+
+def host_fan_out(stacked_results, members):
+    """Host-side per-member slicing after a grouped dispatch is the
+    CORRECT fan-out — untraced, so the member-unroll shape stays quiet."""
+    return [
+        jax.tree.map(lambda leaf: leaf[g], stacked_results)
+        for g in range(members)
+    ]
+
+
+@jax.jit
+def vmapped_group(stacked, x):
+    """The grouped executor's shape: one vmapped program over the member
+    axis, no per-member loop inside the trace."""
+    return jax.vmap(lambda member: jnp.sum(member["w"] * x))(stacked)
 '''
 }
 
