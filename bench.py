@@ -313,6 +313,40 @@ def _child_measure() -> None:
     # coverage path moves (every tapped f32 activation) — the number the
     # trend gate watches to keep the chain fused. TIP_BENCH_FUSED_CHAIN=0
     # skips; failures record an error, never take the bench down.
+    # Roofline grades for the run programs the companions dispatch: the
+    # devicemeter registry holds each program's XLA cost_analysis (stamped
+    # at AOT compile), and the dispatch-latency quantiles land in the
+    # metrics registry per dispatch. grade() divides the two against the
+    # chip's nominal peaks. Advisory: any failure returns None and the
+    # companion record simply lacks the "device_cost" section.
+    def _grade_programs(progs, dt_override=None, models_per_dispatch=None):
+        try:
+            from simple_tip_tpu.obs import devicemeter
+
+            platform_dm, kind_dm, cores_dm = devicemeter.detect_device()
+            quantiles = obs.metrics_snapshot().get("quantiles", {}) or {}
+            out = {}
+            for prog in progs:
+                cost = devicemeter.program_cost(prog)
+                q = quantiles.get(f"run_program.dispatch_s.{prog}") or {}
+                dt = dt_override if dt_override is not None else q.get("p50")
+                if not cost or not isinstance(dt, (int, float)) or dt <= 0:
+                    continue
+                graded = devicemeter.grade(
+                    cost, float(dt), platform=platform_dm,
+                    device_kind=kind_dm, cores=cores_dm,
+                )
+                if q:
+                    graded["dispatch_s"] = {
+                        k: q.get(k) for k in ("count", "p50", "p95", "p99")
+                    }
+                if models_per_dispatch is not None:
+                    graded["models_per_dispatch"] = models_per_dispatch
+                out[prog] = graded
+            return out or None
+        except Exception:  # noqa: BLE001 — grading must never fail the bench
+            return None
+
     fused_chain_info = None
     if os.environ.get("TIP_BENCH_FUSED_CHAIN", "1").strip().lower() not in (
         "0",
@@ -373,6 +407,9 @@ def _child_measure() -> None:
                 "per_phase_host_bytes_per_input_estimate": n_neurons * 4
                 + fused_bytes,
             }
+            fc_grades = _grade_programs(("chain", "rank", "select"))
+            if fc_grades:
+                fused_chain_info["device_cost"] = fc_grades
         except Exception as e:  # noqa: BLE001 — record, never fail the bench
             fused_chain_info = {"error": repr(e)[:300]}
 
@@ -436,6 +473,17 @@ def _child_measure() -> None:
                         round(dispatches / n_badges, 4) if n_badges else None
                     ),
                 }
+                # per-G grade: the registry holds THIS G's compile cost
+                # (cache=None forces a fresh AOT per G), and walk-seconds /
+                # dispatches is the per-G mean latency the shared quantile
+                # can't give (it mixes every G in the sweep)
+                g_grades = _grade_programs(
+                    ("group_chain",),
+                    dt_override=(g_dt / dispatches if dispatches else None),
+                    models_per_dispatch=g,
+                )
+                if g_grades:
+                    sweep[str(g)]["device_cost"] = g_grades
             grouped_chain_info = {
                 "group_sizes": list(g_values),
                 "n_inputs": n_fc,
@@ -444,6 +492,16 @@ def _child_measure() -> None:
                 "host_bytes_per_input": grouped_bytes,
                 "sweep": sweep,
             }
+            # flatten per-G grades under the section-level key obs/store
+            # and obs/regress read (program@gN is the G-sweep row naming)
+            sweep_grades = {
+                f"group_chain@g{g}": entry["device_cost"]["group_chain"]
+                for g, entry in sweep.items()
+                if isinstance(entry.get("device_cost"), dict)
+                and "group_chain" in entry["device_cost"]
+            }
+            if sweep_grades:
+                grouped_chain_info["device_cost"] = sweep_grades
         except Exception as e:  # noqa: BLE001 — record, never fail the bench
             grouped_chain_info = {"error": repr(e)[:300]}
 

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""CI smoke for the device cost observatory (obs/devicemeter.py et al.).
+
+Dependency-free by design (stdlib only — no jax, no numpy): the meter
+math, the capture pilot's compose path, the feature store's
+mfu_breakdown normalizer, the ``obs roofline`` renderer and the
+``obs trend`` MFU floor gate are all exercised end to end from synthetic
+fixtures:
+
+- ``normalize_cost`` tolerates every historical cost_analysis shape
+  (dict / list-of-dicts / junk keys / junk values / empty → None);
+- ``grade`` MFU arithmetic is pinned against hand-computed values on
+  the bundled TPU-v4 peaks; an unknown chip grades ``analytic_only``
+  (achieved rates present, MFU withheld); ``TIP_DEVICE_PEAKS`` overrides
+  the table;
+- ``healthy_window.py --from-record`` composes a schema-stamped
+  ``MFU_BREAKDOWN.json`` from a synthetic bench record (no health
+  surface configured → vacuously healthy window, no bench subprocess);
+- ``obs/store.py`` indexes the capture into ``mfu.*`` / ``dispatch.*``
+  feature rows;
+- ``obs roofline`` exits 0 rendering per-program verdicts (and 2 on a
+  non-breakdown document);
+- ``obs trend`` over the committed ``tests/fixtures/mfu_trend`` series
+  exits 0 on the stable tail and 1 on the MFU-drop tail.
+
+Exit 0 on success, 1 with a diagnostic on the first failed check.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "mfu_trend")
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def _run(argv, env=None):
+    """Run a child in the repo; returns (rc, stdout, stderr)."""
+    merged = dict(os.environ)
+    if env:
+        merged.update(env)
+    proc = subprocess.run(
+        argv, capture_output=True, text=True, cwd=REPO, env=merged
+    )
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+def main() -> int:  # noqa: PLR0911 — a smoke is a list of checks
+    from simple_tip_tpu.obs import devicemeter
+
+    # -- normalize_cost: every historical cost_analysis shape -------------
+    cases = [
+        ({"flops": 100.0, "bytes accessed": 50.0}, {"flops": 100.0, "bytes_accessed": 50.0}),
+        ([{"flops": 7}], {"flops": 7.0}),
+        ({"flops": "junk", "bytes_accessed": 8, "other key": 1}, {"bytes_accessed": 8.0}),
+        ({"flops": -5}, None),
+        ({}, None),
+        ("not a dict", None),
+        (None, None),
+    ]
+    for raw, want in cases:
+        got = devicemeter.normalize_cost(raw)
+        if got != want:
+            return _fail(f"normalize_cost({raw!r}) = {got!r}, want {want!r}")
+
+    # -- grade: pinned MFU arithmetic on the bundled v4 peaks -------------
+    g = devicemeter.grade(
+        {"flops": 2.75e12, "bytes_accessed": 1.228e10},
+        0.1, platform="tpu", device_kind="TPU v4",
+    )
+    if abs(g["mfu"] - 0.1) > 1e-9 or abs(g["hbm_frac"] - 0.1) > 1e-9:
+        return _fail(f"v4 grade math off: mfu={g['mfu']} hbm_frac={g['hbm_frac']}")
+    if g["bound"] != "compute" or g["analytic_only"]:
+        return _fail(f"v4 grade verdict off: {g}")
+
+    g = devicemeter.grade({"flops": 1e9}, 0.01, platform="tpu", device_kind="TPU v99")
+    if not g["analytic_only"] or g["mfu"] is not None:
+        return _fail(f"unknown chip must grade analytic_only without MFU: {g}")
+    if g["achieved_flops_per_s"] != 1e11:
+        return _fail(f"achieved FLOP/s must survive analytic_only: {g}")
+
+    os.environ["TIP_DEVICE_PEAKS"] = json.dumps(
+        {"v99": {"flops_per_s": 1e12, "hbm_bytes_per_s": 1e11, "label": "ci-v99"}}
+    )
+    try:
+        g = devicemeter.grade({"flops": 1e9}, 0.01, platform="tpu", device_kind="TPU v99")
+        if g["analytic_only"] or abs(g["mfu"] - 0.1) > 1e-9 or g["peak_label"] != "ci-v99":
+            return _fail(f"TIP_DEVICE_PEAKS override not honored: {g}")
+    finally:
+        os.environ.pop("TIP_DEVICE_PEAKS", None)
+
+    # -- healthy_window --from-record: compose the capture artifact -------
+    tmp = tempfile.mkdtemp(prefix="devicemeter_smoke_")
+    record = {
+        "metric": "ci_synthetic", "value": 1.0, "platform": "tpu",
+        "degraded": False,
+        "fused_chain": {"device_cost": {
+            "chain": {"flops": 8.25e11, "bytes_accessed": 2.0e9,
+                      "dispatch_s": {"count": 40, "p50": 0.01, "p95": 0.012,
+                                     "p99": 0.013}},
+        }},
+        "grouped_chain": {"device_cost": {
+            "group_chain@g4": {"flops": 3.3e12, "bytes_accessed": 8.0e9,
+                               "dispatch_s": {"count": 10, "p50": 0.04,
+                                              "p95": 0.046, "p99": 0.05},
+                               "models_per_dispatch": 4},
+        }},
+    }
+    record_path = os.path.join(tmp, "bench_record.json")
+    with open(record_path, "w", encoding="utf-8") as f:
+        json.dump(record, f)
+    env = {k: v for k, v in os.environ.items()}
+    env.pop("TIP_BREAKER_STATE", None)  # no health surface: vacuous window
+    env.pop("TIP_HEALTHZ_URL", None)
+    index_dir = os.path.join(tmp, "index")
+    rc, out, err = _run(
+        [sys.executable, os.path.join(REPO, "scripts", "healthy_window.py"),
+         "--once", "--from-record", record_path, "--out", tmp,
+         "--index", index_dir],
+        env=env,
+    )
+    if rc != 0:
+        return _fail(f"healthy_window --from-record exited {rc}: {err}")
+    capture = os.path.join(tmp, "MFU_BREAKDOWN.json")
+    with open(capture, encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("schema") != devicemeter.SCHEMA or doc.get("kind") != devicemeter.KIND:
+        return _fail(f"capture not schema-stamped: {list(doc)[:8]}")
+    if set(doc["programs"]) != {"chain", "group_chain@g4"}:
+        return _fail(f"capture programs off: {sorted(doc['programs'])}")
+    if "captured_unix" not in doc:
+        return _fail("capture must stamp captured_unix")
+
+    # -- store: the capture lands as mfu.* / dispatch.* feature rows ------
+    from simple_tip_tpu.obs import store
+
+    rows = store.load_rows(index_dir)
+    phases = {r["phase"] for r in rows}
+    for needle in ("mfu.chain", "mfu.group_chain@g4", "dispatch.chain"):
+        if needle not in phases:
+            return _fail(f"store rows missing {needle!r}: {sorted(phases)}")
+    g4 = next(r for r in rows if r["phase"] == "mfu.group_chain@g4")
+    if g4.get("group") != 4:
+        return _fail(f"G-sweep row must carry group=4: {g4}")
+
+    # -- obs roofline: renders verdicts; rejects a non-breakdown doc ------
+    rc, out, err = _run([sys.executable, "-m", "simple_tip_tpu.obs",
+                         "roofline", capture])
+    if rc != 0:
+        return _fail(f"obs roofline exited {rc}: {err}")
+    if "compute-bound" not in out and "HBM-bound" not in out:
+        return _fail(f"obs roofline rendered no verdict:\n{out}")
+    if "(G=4)" not in out:
+        return _fail(f"obs roofline must mark the G-sweep row:\n{out}")
+    rc, _, _ = _run([sys.executable, "-m", "simple_tip_tpu.obs",
+                     "roofline", record_path])
+    if rc != 2:
+        return _fail(f"roofline on a non-breakdown doc must exit 2, got {rc}")
+
+    # -- obs trend: MFU floor gate over the committed fixture series ------
+    history = [os.path.join(FIXTURES, f"m0{i}.json") for i in (1, 2, 3, 4)]
+    rc, _, _ = _run([sys.executable, "-m", "simple_tip_tpu.obs", "trend"]
+                    + history + [os.path.join(FIXTURES, "m05_stable.json")])
+    if rc != 0:
+        return _fail(f"trend on the stable MFU series must exit 0, got {rc}")
+    rc, out, _ = _run([sys.executable, "-m", "simple_tip_tpu.obs", "trend"]
+                      + history + [os.path.join(FIXTURES, "m05_drop.json")])
+    if rc != 1:
+        return _fail(f"trend on the MFU-drop series must exit 1, got {rc}")
+    if "mfu.chain" not in out:
+        return _fail(f"trend drop verdict must name the mfu.chain floor:\n{out}")
+
+    print("devicemeter smoke OK (meter math, capture, store rows, "
+          "roofline CLI, MFU trend gate)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

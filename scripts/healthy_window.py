@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Healthy-window pilot: capture MFU_BREAKDOWN.json when the stack is well.
+
+An MFU capture taken while the breaker is open (or the backend is
+degraded) pollutes the trend history with numbers that measure the
+outage, not the code. This pilot closes that gap: it polls the
+resilience surfaces until it sees a *healthy window* — the circuit
+breaker closed (``TIP_BREAKER_STATE``) and, when an exporter is up, the
+``/healthz`` route answering 200 ``ok: true`` — for
+``TIP_HEALTHY_STREAK`` consecutive polls, then runs the bench's
+fused-chain + grouped G-sweep lanes once and composes their devicemeter
+grades into a schema-stamped ``MFU_BREAKDOWN.json``
+(obs/devicemeter.build_breakdown), refreshing the obs feature-store
+index so ``obs trend`` gates the capture like any other snapshot.
+
+Stdlib-only pilot (urllib for /healthz; the bench subprocess is where
+jax lives). ``--from-record`` composes from an existing bench JSON
+record without dispatching anything — the CI smoke path.
+
+Knobs: ``TIP_HEALTHY_POLL_S`` (default 5), ``TIP_HEALTHY_DEADLINE_S``
+(default 900), ``TIP_HEALTHY_STREAK`` (default 2), ``TIP_HEALTHZ_URL``
+(optional exporter healthz endpoint).
+
+Exit 0 on capture; 2 when the bench record is unusable (no devicemeter
+grades); 4 when no healthy window opened before the deadline.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _err(msg: str) -> None:
+    print(f"healthy_window: {msg}", file=sys.stderr)
+
+
+def _fail(msg: str, code: int) -> int:
+    _err(msg)
+    return code
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def check_health() -> tuple:
+    """(healthy, reason) from the breaker + optional /healthz route.
+
+    With neither surface configured the verdict is vacuously healthy —
+    stated loudly in the reason so an operator knows nothing was checked.
+    """
+    checked = []
+    from simple_tip_tpu.resilience.breaker import CircuitBreaker
+
+    br = CircuitBreaker.from_env(name="backend")
+    if br is not None:
+        if not br.healthy():
+            return False, f"breaker {br.name!r} is {br.state()}"
+        checked.append(f"breaker={br.state()}")
+    url = os.environ.get("TIP_HEALTHZ_URL", "").strip()
+    if url:
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                doc = json.loads(resp.read().decode("utf-8"))
+            if resp.status != 200 or doc.get("ok") is not True:
+                return False, f"{url} answered {resp.status} ok={doc.get('ok')}"
+            checked.append("healthz=ok")
+        except (urllib.error.URLError, ValueError, OSError) as e:
+            return False, f"{url} unreachable ({e})"
+    if not checked:
+        return True, "no health surface configured (vacuously healthy)"
+    return True, " ".join(checked)
+
+
+def wait_for_healthy_window(poll_s: float, deadline_s: float, streak: int) -> bool:
+    """Block until ``streak`` consecutive healthy polls; False on deadline."""
+    deadline = time.monotonic() + deadline_s
+    run = 0
+    while True:
+        healthy, reason = check_health()
+        run = run + 1 if healthy else 0
+        _err(f"poll: {'healthy' if healthy else 'UNHEALTHY'} ({reason}) "
+             f"[{run}/{streak}]")
+        if run >= streak:
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(poll_s)
+
+
+def run_bench(groups: str) -> dict:
+    """One bench run (fused-chain + grouped lanes on, serving lane off);
+    returns the parsed record or raises RuntimeError."""
+    env = dict(os.environ)
+    env["TIP_BENCH_FUSED_CHAIN"] = "1"
+    if groups:
+        env["TIP_BENCH_CHAIN_GROUPS"] = groups
+    env.setdefault("TIP_BENCH_SERVING", "0")  # MFU lanes only: keep it short
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, cwd=REPO,
+    )
+    record = None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            record = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if record is None:
+        raise RuntimeError(
+            f"bench.py produced no JSON record (rc={proc.returncode}); "
+            f"stderr tail: {proc.stderr[-400:]!r}"
+        )
+    return record
+
+
+def programs_from_record(record: dict) -> dict:
+    """The devicemeter grade sections of one bench record, reshaped into
+    ``build_breakdown``'s programs input (cost + dispatch summary)."""
+    grades = {}
+    for section in ("fused_chain", "grouped_chain"):
+        grades.update((record.get(section) or {}).get("device_cost") or {})
+    programs = {}
+    for name, g in grades.items():
+        if not isinstance(g, dict):
+            continue
+        cost = {
+            key: g[key]
+            for key in ("flops", "bytes_accessed", "peak_memory_bytes")
+            if isinstance(g.get(key), (int, float))
+        }
+        entry = {"cost": cost or None, "dispatch_s": g.get("dispatch_s")}
+        if g.get("models_per_dispatch") is not None:
+            entry["models_per_dispatch"] = g["models_per_dispatch"]
+        programs[name] = entry
+    return programs
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=REPO,
+                    help="directory for MFU_BREAKDOWN.json (default: repo root)")
+    ap.add_argument("--index", default=None,
+                    help="obs feature-store index dir to refresh after capture")
+    ap.add_argument("--from-record", default=None,
+                    help="compose from an existing bench JSON record "
+                         "(no bench subprocess; CI smoke path)")
+    ap.add_argument("--groups", default="",
+                    help="grouped-chain G sweep override (TIP_BENCH_CHAIN_GROUPS)")
+    ap.add_argument("--once", action="store_true",
+                    help="single health check: exit 4 immediately if unhealthy")
+    args = ap.parse_args()
+
+    from simple_tip_tpu.obs import devicemeter
+
+    poll_s = _env_f("TIP_HEALTHY_POLL_S", 5.0)
+    deadline_s = _env_f("TIP_HEALTHY_DEADLINE_S", 900.0)
+    streak = max(1, int(_env_f("TIP_HEALTHY_STREAK", 2)))
+    if args.once:
+        deadline_s, streak = 0.0, 1
+
+    if not wait_for_healthy_window(poll_s, deadline_s, streak):
+        return _fail(
+            f"no healthy window within {deadline_s:.0f}s — not capturing "
+            "(an MFU number measured during an outage would poison the trend)",
+            4,
+        )
+
+    if args.from_record:
+        try:
+            with open(args.from_record, encoding="utf-8") as f:
+                record = json.load(f)
+        except (OSError, ValueError) as e:
+            return _fail(f"--from-record {args.from_record}: {e}", 2)
+    else:
+        try:
+            record = run_bench(args.groups)
+        except RuntimeError as e:
+            return _fail(str(e), 2)
+
+    if record.get("degraded"):
+        # the window closed between the poll and the walk (or the bench
+        # fell back to CPU): still a capture, but stamped so the trend
+        # gate's degraded guard treats it accordingly
+        _err("bench record is DEGRADED; stamping the capture as such")
+
+    programs = programs_from_record(record)
+    if not programs:
+        return _fail(
+            "bench record carries no devicemeter grades "
+            "(fused_chain/grouped_chain device_cost sections absent)", 2,
+        )
+
+    platform, device_kind, cores = devicemeter.detect_device()
+    doc = devicemeter.build_breakdown(
+        programs,
+        platform=str(record.get("platform") or platform),
+        device_kind=device_kind,
+        cores=cores,
+        degraded=bool(record.get("degraded", False)),
+        captured_unix=time.time(),
+        extra={"source_metric": record.get("metric"),
+               "source_value": record.get("value")},
+    )
+
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, "MFU_BREAKDOWN.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)  # atomic: a reader never sees a torn capture
+    print(devicemeter.render_roofline(
+        devicemeter.rows_from_breakdown(doc),
+        header=f"{path}  [{doc['platform']}/{doc['device_kind']}"
+               f"{', DEGRADED' if doc['degraded'] else ''}]",
+    ))
+
+    if args.index:
+        from simple_tip_tpu.obs import store
+
+        report = store.refresh([args.out], args.index)
+        _err(f"indexed {len(report['indexed'])} source(s) "
+             f"(+{report['rows_appended']} rows) into {report['index']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

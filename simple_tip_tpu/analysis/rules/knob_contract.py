@@ -82,6 +82,14 @@ NON_PLANNER_KNOBS = frozenset(
         "TIP_OBS_MEMPOLL_S",
         "TIP_OBS_WORKER",
         "TIP_OBS_PLATFORM",
+        # device cost observatory (obs/devicemeter.py) + the
+        # healthy-window capture pilot (scripts/healthy_window.py):
+        # calibration/operations knobs, not searched plan dimensions
+        "TIP_DEVICE_PEAKS",
+        "TIP_HEALTHZ_URL",
+        "TIP_HEALTHY_POLL_S",
+        "TIP_HEALTHY_DEADLINE_S",
+        "TIP_HEALTHY_STREAK",
         # serving admission control (serving/knobs.py) — the badge bound
         # TIP_SERVE_MAX_BADGE is planner-owned; these are load-shed policy
         "TIP_SERVE_SHED_MODE",

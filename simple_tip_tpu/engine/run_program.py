@@ -40,10 +40,32 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from simple_tip_tpu import obs
+from simple_tip_tpu.obs import devicemeter
 from simple_tip_tpu.ops.timer import Timer
 from simple_tip_tpu.utils.artifacts_io import atomic_write_bytes
 
 logger = logging.getLogger(__name__)
+
+#: Memoized (platform, device_kind, device count) for dispatch grading —
+#: resolved once per process, after the first program is in hand (so the
+#: backend is already initialized and the query is free).
+_device_info_cache = None
+
+
+def _device_info():
+    global _device_info_cache
+    if _device_info_cache is None:
+        _device_info_cache = devicemeter.detect_device()
+    return _device_info_cache
+
+
+def _observe_dispatch(program: str, dt_s: float) -> None:
+    """Grade one measured dispatch against the program's analytic cost
+    (devicemeter registry; stamped at compile, recovered on cache hit)."""
+    platform, kind, cores = _device_info()
+    devicemeter.observe_dispatch(
+        program, dt_s, platform=platform, device_kind=kind, cores=cores
+    )
 
 #: Bump when the chain/rank program semantics or the entry layout change;
 #: stale-version entries are treated as misses.
@@ -241,6 +263,13 @@ class ProgramCache:
             )
             obs.counter("program_cache.hit").inc()
             obs.event("program_cache", outcome="hit", program=meta.get("program"))
+            # cost_analysis() can fail on deserialized executables, so the
+            # compile-time cost stamped into the meta is the recovery path
+            # for dispatch grading on a warm cache
+            if meta.get("cost"):
+                devicemeter.record_program_cost(
+                    meta.get("program") or "", meta["cost"], fingerprint=key[:16]
+                )
             try:
                 os.utime(path)  # LRU recency: a hit entry is the last swept
             except OSError:
@@ -258,9 +287,12 @@ class ProgramCache:
             obs.event("program_cache", outcome="corrupt")
             return None
 
-    def store(self, key: str, compiled, program: str = "") -> None:
+    def store(self, key: str, compiled, program: str = "", cost=None) -> None:
         """Persist one compiled executable (atomic; failures warn, never
-        raise — the cache is an optimization only)."""
+        raise — the cache is an optimization only). ``cost`` is the
+        compile-time ``cost_analysis()`` stamp, advisory fingerprint-adjacent
+        metadata: entries without it (older caches) just skip dispatch
+        grading, so no format-version bump."""
         try:
             from jax.experimental import serialize_executable
 
@@ -271,6 +303,7 @@ class ProgramCache:
                     "version": PROGRAM_FORMAT_VERSION,
                     "fingerprint": key,
                     "program": program,
+                    **({"cost": cost} if cost else {}),
                 },
                 "payload": payload,
                 "in_tree": in_tree,
@@ -365,9 +398,18 @@ def aot_compile(jitted, arg_specs, cache: Optional[ProgramCache], key: str, prog
                     compiled = jitted.lower(*arg_specs).compile()
             else:
                 compiled = jitted.lower(*arg_specs).compile()
+        # analytic cost accounting: only a FRESH compile reliably answers
+        # cost_analysis(), so this is the one place the stamp can be made
+        cost = devicemeter.extract_cost(compiled)
+        devicemeter.record_program_cost(program, cost, fingerprint=key[:16])
         sp.set(cached=False, compile_s=round(timer.get(), 6), fingerprint=key[:16])
+        if cost:
+            sp.set(
+                cost_flops=cost.get("flops"),
+                cost_bytes=cost.get("bytes_accessed"),
+            )
     if cache is not None:
-        cache.store(key, compiled, program=program)
+        cache.store(key, compiled, program=program, cost=cost)
     return compiled
 
 
@@ -549,8 +591,11 @@ class FusedChainRunner:
         if padded_n > n:
             values = np.concatenate([values, np.zeros(padded_n - n, np.float32)])
         prog = self._select_program(padded_n, k)
-        picked = prog(values, np.int32(n))
+        timer = Timer()
+        with timer:
+            picked = prog(values, np.int32(n))
         obs.counter("run_program.select_dispatches").inc()
+        _observe_dispatch("select", timer.get())
         return np.asarray(picked).astype(np.int64)
 
     # -- evaluation ----------------------------------------------------------
@@ -601,6 +646,7 @@ class FusedChainRunner:
                     score_acc.setdefault(mid, []).append(np.asarray(s)[:valid])
                     packed_acc[mid].append(p)
             chain_s += timer.get()
+            _observe_dispatch("chain", timer.get())
 
         pred = np.concatenate(preds, axis=0)
         uncertainties = {k: np.concatenate(v, axis=0) for k, v in unc_acc.items()}
@@ -629,6 +675,7 @@ class FusedChainRunner:
                 picked = np.asarray(picked_dev)[:count].astype(np.int64)
                 order = _with_score_tail(scores[mid], picked)
             cov_times[mid].append(timer.get())
+            _observe_dispatch("rank", timer.get())
             cam_orders[mid] = order
             self._sanity_check(order, scores[mid])
         if rng is not None and getattr(self.model_def, "has_dropout", False):
@@ -1008,6 +1055,7 @@ class GroupChainRunner:
                         score_acc[g].setdefault(mid, []).append(sb[g, :valid])
                     packed_acc[mid].append(p)  # [G, bs, W], stays on device
             chain_s += timer.get()
+            _observe_dispatch("group_chain", timer.get())
 
         share = chain_s / m  # amortized per-member chain time
         results = []
@@ -1046,6 +1094,7 @@ class GroupChainRunner:
                 picked_all = np.asarray(picked_dev)
                 counts = np.asarray(count_dev)
             rank_share = timer.get() / m
+            _observe_dispatch("group_rank", timer.get())
             for g in range(m):
                 picked = picked_all[g, : int(counts[g])].astype(np.int64)
                 order = _with_score_tail(results[g]["scores"][mid], picked)
@@ -1071,8 +1120,11 @@ class GroupChainRunner:
                     vals[g, :n] = np.asarray(
                         results[g]["uncertainties"][name], np.float32
                     )
-                picked = np.asarray(sel_prog(vals, np.int32(n)))
+                timer = Timer()
+                with timer:
+                    picked = np.asarray(sel_prog(vals, np.int32(n)))
                 obs.counter("run_program.select_dispatches").inc()
+                _observe_dispatch("group_select", timer.get())
                 for g in range(m):
                     results[g].setdefault("al_select", {})[name] = picked[
                         g

@@ -33,6 +33,9 @@
 - ``trend``    gate the LAST of N chronological snapshots against robust
   median/MAD trend bands over its non-degraded predecessors
   (``obs/regress.py``'s N-run upgrade of the 2-run diff).
+- ``roofline`` per-program MFU / achieved-bandwidth table with a
+  compute-bound vs HBM-bound verdict per program (``obs/devicemeter.py``),
+  from ``MFU_BREAKDOWN.json`` captures or a run's live dispatch gauges.
 
 Exit codes (``regress`` and ``trend``, so CI can tell skip from failure):
 **0** inside the band / no regression, **1** regression detected,
@@ -553,6 +556,92 @@ def _predict(args) -> int:
     return 0
 
 
+def _merged_metrics_snapshot(events) -> dict:
+    """Final metrics flush per pid, gauges/quantiles merged across
+    processes (last flush wins per name) — the live-registry view
+    ``obs roofline`` reads out of a run directory."""
+    last_by_pid = {}
+    for rec in events:
+        if rec.get("type") == "metrics" and rec.get("pid") is not None:
+            last_by_pid[rec["pid"]] = rec
+    gauges, quantiles = {}, {}
+    for pid in sorted(last_by_pid):
+        rec = last_by_pid[pid]
+        for name, v in (rec.get("gauges") or {}).items():
+            if isinstance(v, (int, float)):
+                gauges[name] = v
+        for name, v in (rec.get("quantiles") or {}).items():
+            if isinstance(v, dict):
+                quantiles[name] = v
+    return {"gauges": gauges, "quantiles": quantiles}
+
+
+def _roofline(args) -> int:
+    """``obs roofline`` entry: per-program MFU/bandwidth table with a
+    compute-bound vs HBM-bound verdict per program. Targets are
+    ``MFU_BREAKDOWN.json`` captures (devicemeter documents) and/or obs
+    run dirs / ``events-*.jsonl`` streams (live gauges + dispatch
+    quantiles). Exit 0 with rows, 3 with nothing to render, 2 bad input."""
+    from simple_tip_tpu.obs import devicemeter
+
+    sections = []
+    for target in args.targets:
+        if os.path.isdir(target) or str(target).endswith(".jsonl"):
+            events, files, _bad = load_events(target)
+            if not files:
+                print(
+                    f"obs roofline: {target}: no events-*.jsonl streams found",
+                    file=sys.stderr,
+                )
+                return 2
+            rows = devicemeter.rows_from_metrics(
+                _merged_metrics_snapshot(events)
+            )
+            sections.append({"target": str(target), "rows": rows})
+            continue
+        try:
+            with open(target, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(
+                f"obs roofline: {target}: not a readable JSON document ({e})",
+                file=sys.stderr,
+            )
+            return 2
+        if not isinstance(doc, dict) or doc.get("kind") != devicemeter.KIND:
+            print(
+                f"obs roofline: {target}: not an MFU_BREAKDOWN document "
+                f"(kind != {devicemeter.KIND!r})",
+                file=sys.stderr,
+            )
+            return 2
+        rows = devicemeter.rows_from_breakdown(doc)
+        label = (
+            f"{target}  [{doc.get('platform', '?')}/"
+            f"{doc.get('device_kind', '?')}"
+            f"{', DEGRADED' if doc.get('degraded') else ''}]"
+        )
+        sections.append({"target": label, "rows": rows})
+    if not any(s["rows"] for s in sections):
+        print(
+            "obs roofline: no graded programs found (exit 3: nothing to "
+            "render, not a failure)",
+            file=sys.stderr,
+        )
+        return 3
+    if args.json:
+        print(json.dumps(sections, indent=2, sort_keys=True))
+        return 0
+    blocks = []
+    for s in sections:
+        if not s["rows"]:
+            blocks.append(f"{s['target']}\n  (no graded programs)")
+            continue
+        blocks.append(devicemeter.render_roofline(s["rows"], header=s["target"]))
+    print("\n\n".join(blocks))
+    return 0
+
+
 def _trend(args) -> int:
     """``obs trend`` entry: N-run trend gate; exit 0/1/2/3."""
     from simple_tip_tpu.obs import regress as regress_mod
@@ -734,6 +823,19 @@ def main(argv=None) -> int:
     )
     tp.add_argument("--json", action="store_true", help="machine-readable output")
 
+    rfp = sub.add_parser(
+        "roofline",
+        help="per-program MFU / bandwidth table with compute-bound vs "
+        "HBM-bound verdicts (devicemeter; exit 3 when nothing is graded)",
+    )
+    rfp.add_argument(
+        "targets",
+        nargs="+",
+        help="MFU_BREAKDOWN.json captures and/or obs run dirs / "
+        "events-*.jsonl streams",
+    )
+    rfp.add_argument("--json", action="store_true", help="machine-readable output")
+
     tailp = sub.add_parser(
         "tail",
         help="merged live tail of a run's event streams (obs v4)",
@@ -818,6 +920,8 @@ def main(argv=None) -> int:
         return _predict(args)
     if args.command == "trend":
         return _trend(args)
+    if args.command == "roofline":
+        return _roofline(args)
 
     events, files, bad = load_events(args.target)
     if args.command == "summary":

@@ -1,0 +1,578 @@
+"""Device cost observatory: analytic FLOPs/bytes accounting per program.
+
+The hot path is a handful of AOT-compiled programs (fused chain, grouped
+G-chain, rank/select); the host-side telemetry plane times them but has
+no idea how much *arithmetic* each dispatch represents. This module
+closes that gap with three pieces, all stdlib-only so the meter math is
+testable (and CI-smokable) without jax:
+
+- **cost extraction** — ``extract_cost(compiled)`` pulls XLA's
+  ``cost_analysis()`` (flops, bytes accessed) plus ``memory_analysis()``
+  (peak memory) off a compiled executable, tolerating every historical
+  shape of that API (dict, list-of-dicts, missing keys, hard failure on
+  deserialized executables → ``None``). ``normalize_cost`` is the pure
+  half, unit-tested on synthetic dicts.
+- **peak tables + grading** — ``resolve_peaks`` maps (platform,
+  device_kind) to peak FLOP/s and HBM bytes/s: a ``TIP_DEVICE_PEAKS``
+  JSON env override first, then bundled defaults for TPU v4 and CPU.
+  Unknown chips resolve to ``analytic_only=True`` — achieved FLOP/s and
+  bytes/s are still reported (they need no peak), but MFU and the
+  roofline verdict are withheld rather than silently graded against the
+  wrong chip. ``grade(cost, dt_s, ...)`` turns one measured dispatch
+  into achieved-FLOPs/s, achieved-HBM-GB/s, MFU, HBM fraction, and a
+  compute-bound vs HBM-bound verdict (whichever roofline ceiling is
+  closer).
+- **live attribution** — ``record_program_cost`` keeps an in-process
+  registry of per-program costs (stamped at AOT compile time by
+  ``engine/run_program.py``, recovered from ProgramCache metadata on
+  cache hits); ``observe_dispatch`` feeds per-program dispatch-latency
+  Quantile windows plus MFU / bandwidth gauges into the metrics
+  registry, so they flow to ``/metrics`` via the exporter and to
+  ``obs roofline`` / ``obs trend`` via the stream.
+
+``build_breakdown`` composes the schema-stamped ``MFU_BREAKDOWN.json``
+document (per-program cost analysis × measured dispatch time) that
+``scripts/healthy_window.py`` captures and ``obs/store.py`` /
+``obs/regress.py`` consume.
+"""
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from simple_tip_tpu import obs
+
+SCHEMA = 1
+KIND = "mfu_breakdown"
+
+# Bundled peak table. Deliberately small: TPU v4 (the chip the study
+# targets; bf16 matmul peak + HBM2 bandwidth) and a nominal CPU core
+# (f32 FMA peak per core, single-socket DDR bandwidth). Anything else
+# must come in through TIP_DEVICE_PEAKS or be graded analytic_only —
+# a wrong peak table produces confidently-wrong MFU, which is worse
+# than none.
+_BUILTIN_PEAKS = {
+    "v4": {
+        "flops_per_s": 275e12,
+        "hbm_bytes_per_s": 1228e9,
+        "label": "tpu-v4-bf16",
+    },
+    "cpu": {
+        "flops_per_s": 96e9,  # per core; scaled by ``cores``
+        "hbm_bytes_per_s": 25.6e9,
+        "label": "cpu-core-f32-nominal",
+        "per_core_flops": True,
+    },
+}
+
+_COST_KEY_ALIASES = {
+    "flops": "flops",
+    "bytes accessed": "bytes_accessed",
+    "bytes_accessed": "bytes_accessed",
+    "peak memory": "peak_memory_bytes",
+    "peak_memory_bytes": "peak_memory_bytes",
+    "optimal seconds": "optimal_seconds",
+    "optimal_seconds": "optimal_seconds",
+}
+
+_lock = threading.Lock()
+_program_costs: Dict[str, dict] = {}
+
+
+# -- cost extraction ---------------------------------------------------------
+
+
+def normalize_cost(raw) -> Optional[dict]:
+    """Normalize one ``cost_analysis()`` result to canonical keys.
+
+    Tolerates every shape the API has had: a dict, a list of per-device
+    dicts (first entry wins), missing keys (→ absent, never KeyError),
+    and junk values (non-numeric entries are dropped). Returns None when
+    nothing usable survives.
+    """
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else None
+    if not isinstance(raw, dict):
+        return None
+    out = {}
+    for key, value in raw.items():
+        name = _COST_KEY_ALIASES.get(str(key).lower())
+        if name is None:
+            continue
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            continue
+        if value >= 0:
+            out[name] = value
+    return out or None
+
+
+def extract_cost(compiled) -> Optional[dict]:
+    """Best-effort analytic cost of one compiled executable.
+
+    ``cost_analysis()`` can raise on deserialized executables (the
+    ProgramCache-hit path recovers the cost from the entry's metadata
+    instead) and ``memory_analysis()`` is optional everywhere — both are
+    advisory, so every failure collapses to None/absent.
+    """
+    cost = None
+    try:
+        cost = normalize_cost(compiled.cost_analysis())
+    except Exception:  # noqa: BLE001 — advisory, never load-bearing
+        cost = None
+    try:
+        mem = compiled.memory_analysis()
+        peak = 0.0
+        for attr in (
+            "argument_size_in_bytes",
+            "output_size_in_bytes",
+            "temp_size_in_bytes",
+            "generated_code_size_in_bytes",
+        ):
+            val = getattr(mem, attr, None)
+            if isinstance(val, (int, float)) and val > 0:
+                peak += float(val)
+        if peak > 0:
+            cost = dict(cost or {})
+            cost.setdefault("peak_memory_bytes", peak)
+    except Exception:  # noqa: BLE001
+        pass
+    return cost
+
+
+# -- peak tables -------------------------------------------------------------
+
+
+def _env_peaks() -> Dict[str, dict]:
+    """The ``TIP_DEVICE_PEAKS`` override table: a JSON object mapping a
+    lowercase device-kind substring (or platform name) to
+    ``{"flops_per_s": ..., "hbm_bytes_per_s": ..., "label": ...}``.
+    Malformed JSON or entries are ignored (the bundled table still
+    applies) — a typo'd override must not take the meter down."""
+    raw = os.environ.get("TIP_DEVICE_PEAKS", "")
+    if not raw.strip():
+        return {}
+    try:
+        table = json.loads(raw)
+    except (ValueError, TypeError):
+        return {}
+    if not isinstance(table, dict):
+        return {}
+    out = {}
+    for key, entry in table.items():
+        if not isinstance(entry, dict):
+            continue
+        peaks = {}
+        for field in ("flops_per_s", "hbm_bytes_per_s"):
+            try:
+                peaks[field] = float(entry[field])
+            except (KeyError, TypeError, ValueError):
+                continue
+        if not peaks:
+            continue
+        peaks["label"] = str(entry.get("label", f"env:{key}"))
+        out[str(key).lower()] = peaks
+    return out
+
+
+def resolve_peaks(
+    platform: Optional[str],
+    device_kind: Optional[str],
+    cores: int = 1,
+) -> dict:
+    """Peak FLOP/s + HBM bytes/s for one device, or an analytic_only stub.
+
+    Resolution order: longest-matching ``TIP_DEVICE_PEAKS`` key (matched
+    as a substring of the lowercased device kind, falling back to the
+    platform name), then the bundled v4/CPU defaults. An unrecognized
+    chip returns ``{"analytic_only": True}`` with no peaks — loud by
+    design, so a new chip gets an explicit table entry rather than a
+    silently-wrong MFU.
+    """
+    platform = (platform or "").lower()
+    kind = (device_kind or "").lower()
+    haystack = kind or platform
+    env = _env_peaks()
+    for key in sorted(env, key=len, reverse=True):
+        if key and (key in haystack or key == platform):
+            entry = dict(env[key])
+            entry.setdefault("analytic_only", False)
+            return entry
+    if "v4" in haystack:
+        return dict(_BUILTIN_PEAKS["v4"], analytic_only=False)
+    if platform == "cpu" or "cpu" in haystack:
+        entry = dict(_BUILTIN_PEAKS["cpu"], analytic_only=False)
+        entry["flops_per_s"] *= max(1, int(cores))
+        entry.pop("per_core_flops", None)
+        return entry
+    return {
+        "analytic_only": True,
+        "label": f"unknown:{device_kind or platform or 'device'}",
+    }
+
+
+# -- grading -----------------------------------------------------------------
+
+
+def grade(
+    cost: Optional[dict],
+    dt_s: Optional[float],
+    platform: Optional[str] = None,
+    device_kind: Optional[str] = None,
+    cores: int = 1,
+    peaks: Optional[dict] = None,
+) -> dict:
+    """Grade one measured dispatch against the device roofline.
+
+    Returns a JSON-safe dict: achieved FLOP/s and HBM bytes/s (whenever
+    the cost and a positive dt are known), MFU and HBM fraction
+    (additionally requiring peaks), and ``bound`` — ``"compute"`` or
+    ``"hbm"`` by whichever roofline ceiling the dispatch sits closer to,
+    ``"unknown"`` when the verdict cannot be computed. ``analytic_only``
+    is True whenever the peak table did not recognize the chip.
+    """
+    if peaks is None:
+        peaks = resolve_peaks(platform, device_kind, cores=cores)
+    cost = cost or {}
+    out = {
+        "flops": cost.get("flops"),
+        "bytes_accessed": cost.get("bytes_accessed"),
+        "peak_memory_bytes": cost.get("peak_memory_bytes"),
+        "dispatch_s": dt_s,
+        "achieved_flops_per_s": None,
+        "achieved_hbm_bytes_per_s": None,
+        "mfu": None,
+        "hbm_frac": None,
+        "bound": "unknown",
+        "analytic_only": bool(peaks.get("analytic_only", False)),
+        "peak_label": peaks.get("label"),
+        "peak_flops_per_s": peaks.get("flops_per_s"),
+        "peak_hbm_bytes_per_s": peaks.get("hbm_bytes_per_s"),
+    }
+    if not dt_s or dt_s <= 0:
+        return out
+    flops = cost.get("flops")
+    bytes_accessed = cost.get("bytes_accessed")
+    if flops is not None:
+        out["achieved_flops_per_s"] = flops / dt_s
+        if peaks.get("flops_per_s"):
+            out["mfu"] = out["achieved_flops_per_s"] / peaks["flops_per_s"]
+    if bytes_accessed is not None:
+        out["achieved_hbm_bytes_per_s"] = bytes_accessed / dt_s
+        if peaks.get("hbm_bytes_per_s"):
+            out["hbm_frac"] = (
+                out["achieved_hbm_bytes_per_s"] / peaks["hbm_bytes_per_s"]
+            )
+    if out["mfu"] is not None and out["hbm_frac"] is not None:
+        out["bound"] = "compute" if out["mfu"] >= out["hbm_frac"] else "hbm"
+    elif out["mfu"] is not None:
+        out["bound"] = "compute"
+    elif out["hbm_frac"] is not None:
+        out["bound"] = "hbm"
+    return out
+
+
+# -- the in-process cost registry -------------------------------------------
+
+
+def record_program_cost(
+    program: str, cost: Optional[dict], fingerprint: Optional[str] = None
+) -> None:
+    """Remember one program's analytic cost (compile-time stamp or
+    ProgramCache-hit recovery). A None cost is remembered as absent so a
+    later hit cannot resurrect a stale entry from a previous program."""
+    cost = normalize_cost(cost) if cost else None
+    with _lock:
+        if cost is None:
+            _program_costs.pop(str(program), None)
+        else:
+            _program_costs[str(program)] = {
+                "cost": cost,
+                "fingerprint": fingerprint,
+            }
+
+
+def program_cost(program: str) -> Optional[dict]:
+    """The registered analytic cost for ``program``, or None."""
+    with _lock:
+        entry = _program_costs.get(str(program))
+        return dict(entry["cost"]) if entry else None
+
+
+def program_costs() -> Dict[str, dict]:
+    """Snapshot of every registered program cost (JSON-safe copy)."""
+    with _lock:
+        return {
+            name: {"cost": dict(e["cost"]), "fingerprint": e["fingerprint"]}
+            for name, e in _program_costs.items()
+        }
+
+
+def reset() -> None:
+    """Forget every registered program cost (test isolation)."""
+    with _lock:
+        _program_costs.clear()
+
+
+def observe_dispatch(
+    program: str,
+    dt_s: float,
+    platform: Optional[str] = None,
+    device_kind: Optional[str] = None,
+    cores: int = 1,
+) -> None:
+    """Feed one measured dispatch into the live metrics registry.
+
+    Always lands the dispatch-latency quantile; when the program's cost
+    is registered and the chip is recognized, also sets the per-program
+    MFU / bandwidth / HBM-fraction gauges (last-dispatch values — the
+    quantile window carries the distribution). Never raises: dispatch
+    paths must not fail on telemetry.
+    """
+    try:
+        program = str(program)
+        obs.quantile(f"run_program.dispatch_s.{program}").observe(float(dt_s))
+        cost = program_cost(program)
+        if cost is None:
+            return
+        graded = grade(
+            cost, dt_s, platform=platform, device_kind=device_kind, cores=cores
+        )
+        if graded["mfu"] is not None:
+            obs.gauge(f"run_program.mfu.{program}").set(round(graded["mfu"], 6))
+        if graded["hbm_frac"] is not None:
+            obs.gauge(f"run_program.hbm_frac.{program}").set(
+                round(graded["hbm_frac"], 6)
+            )
+        if graded["achieved_hbm_bytes_per_s"] is not None:
+            obs.gauge(f"run_program.hbm_gbps.{program}").set(
+                round(graded["achieved_hbm_bytes_per_s"] / 1e9, 3)
+            )
+    except Exception:  # noqa: BLE001 — telemetry must not fail a dispatch
+        pass
+
+
+def detect_device() -> Tuple[str, str, int]:
+    """(platform, device_kind, core/chip count) — jax when importable,
+    a CPU fallback otherwise (the meter itself stays stdlib-only)."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        return (
+            devices[0].platform,
+            getattr(devices[0], "device_kind", devices[0].platform),
+            len(devices),
+        )
+    except Exception:  # noqa: BLE001 — no jax / no backend → host CPU
+        return ("cpu", "cpu", os.cpu_count() or 1)
+
+
+# -- MFU_BREAKDOWN documents -------------------------------------------------
+
+
+def build_breakdown(
+    programs: Dict[str, dict],
+    platform: str,
+    device_kind: str,
+    cores: int = 1,
+    degraded: bool = False,
+    captured_unix: Optional[float] = None,
+    extra: Optional[dict] = None,
+) -> dict:
+    """Compose the schema-stamped MFU_BREAKDOWN document.
+
+    ``programs`` maps a program name (free-form; grouped-chain G-sweep
+    entries use e.g. ``group_chain@g4``) to ``{"cost": <normalized cost
+    dict>, "dispatch_s": <seconds | quantile summary dict>}`` plus any
+    extra fields (``models_per_dispatch``, ``n_dispatches``...). Each
+    entry is graded here against one shared peak resolution, so the doc
+    is self-contained for ``obs roofline`` / store / regress.
+    """
+    peaks = resolve_peaks(platform, device_kind, cores=cores)
+    doc = {
+        "schema": SCHEMA,
+        "kind": KIND,
+        "platform": platform,
+        "device_kind": device_kind,
+        "cores": int(cores),
+        "degraded": bool(degraded),
+        "peaks": peaks,
+        "programs": {},
+    }
+    if captured_unix is not None:
+        doc["captured_unix"] = float(captured_unix)
+    for name, entry in sorted(programs.items()):
+        entry = dict(entry or {})
+        cost = normalize_cost(entry.get("cost"))
+        dispatch = entry.get("dispatch_s")
+        summary = None
+        if isinstance(dispatch, dict):
+            summary = dispatch
+            dt_s = dispatch.get("p50") or dispatch.get("mean")
+        else:
+            dt_s = dispatch
+        graded = grade(cost, dt_s, peaks=peaks)
+        row = {
+            "cost": cost,
+            "grade": graded,
+        }
+        if summary is not None:
+            row["dispatch_s"] = summary
+        elif dt_s is not None:
+            row["dispatch_s"] = {"mean": float(dt_s)}
+        for key, value in entry.items():
+            if key not in ("cost", "dispatch_s"):
+                row[key] = value
+        doc["programs"][str(name)] = row
+    if extra:
+        for key, value in extra.items():
+            doc.setdefault(key, value)
+    return doc
+
+
+# -- roofline rows + rendering ----------------------------------------------
+
+
+def rows_from_breakdown(doc: dict) -> List[dict]:
+    """Flatten one MFU_BREAKDOWN document into roofline table rows."""
+    rows = []
+    programs = doc.get("programs")
+    if not isinstance(programs, dict):
+        return rows
+    for name, entry in sorted(programs.items()):
+        graded = (entry or {}).get("grade") or {}
+        dispatch = (entry or {}).get("dispatch_s") or {}
+        rows.append(
+            {
+                "program": str(name),
+                "mfu": graded.get("mfu"),
+                "hbm_frac": graded.get("hbm_frac"),
+                "hbm_gbps": (
+                    graded["achieved_hbm_bytes_per_s"] / 1e9
+                    if graded.get("achieved_hbm_bytes_per_s") is not None
+                    else None
+                ),
+                "gflops_per_s": (
+                    graded["achieved_flops_per_s"] / 1e9
+                    if graded.get("achieved_flops_per_s") is not None
+                    else None
+                ),
+                "p50_ms": (
+                    dispatch["p50"] * 1e3 if dispatch.get("p50") is not None
+                    else (
+                        dispatch["mean"] * 1e3
+                        if dispatch.get("mean") is not None
+                        else None
+                    )
+                ),
+                "p99_ms": (
+                    dispatch["p99"] * 1e3
+                    if dispatch.get("p99") is not None
+                    else None
+                ),
+                "count": dispatch.get("count"),
+                "bound": graded.get("bound", "unknown"),
+                "analytic_only": bool(graded.get("analytic_only", False)),
+                "models_per_dispatch": (entry or {}).get("models_per_dispatch"),
+            }
+        )
+    return rows
+
+
+def rows_from_metrics(snapshot: dict) -> List[dict]:
+    """Roofline rows from one live metrics snapshot (gauges + quantiles
+    as ``observe_dispatch`` lands them) — the run-directory path of
+    ``obs roofline``, where no MFU_BREAKDOWN document exists yet."""
+    gauges = snapshot.get("gauges") or {}
+    quantiles = snapshot.get("quantiles") or {}
+    programs = set()
+    for key in gauges:
+        for prefix in (
+            "run_program.mfu.", "run_program.hbm_frac.", "run_program.hbm_gbps."
+        ):
+            if key.startswith(prefix):
+                programs.add(key[len(prefix):])
+    for key in quantiles:
+        if key.startswith("run_program.dispatch_s."):
+            programs.add(key[len("run_program.dispatch_s."):])
+    rows = []
+    for name in sorted(programs):
+        summary = quantiles.get(f"run_program.dispatch_s.{name}") or {}
+        mfu = gauges.get(f"run_program.mfu.{name}")
+        hbm_frac = gauges.get(f"run_program.hbm_frac.{name}")
+        if mfu is not None and hbm_frac is not None:
+            bound = "compute" if mfu >= hbm_frac else "hbm"
+        elif mfu is not None:
+            bound = "compute"
+        elif hbm_frac is not None:
+            bound = "hbm"
+        else:
+            bound = "unknown"
+        rows.append(
+            {
+                "program": name,
+                "mfu": mfu,
+                "hbm_frac": hbm_frac,
+                "hbm_gbps": gauges.get(f"run_program.hbm_gbps.{name}"),
+                "gflops_per_s": None,
+                "p50_ms": (
+                    summary["p50"] * 1e3
+                    if summary.get("p50") is not None
+                    else None
+                ),
+                "p99_ms": (
+                    summary["p99"] * 1e3
+                    if summary.get("p99") is not None
+                    else None
+                ),
+                "count": summary.get("count"),
+                "bound": bound,
+                "analytic_only": mfu is None and hbm_frac is None,
+                "models_per_dispatch": None,
+            }
+        )
+    return rows
+
+
+def _fmt(value, spec: str = ".3f", none: str = "-") -> str:
+    if value is None:
+        return none
+    try:
+        return format(value, spec)
+    except (TypeError, ValueError):
+        return str(value)
+
+
+def render_roofline(rows: List[dict], header: str = "") -> str:
+    """The ``obs roofline`` table: one line per program, verdict last."""
+    lines = []
+    if header:
+        lines.append(header)
+    lines.append(
+        f"{'program':<24} {'mfu':>8} {'hbm%':>8} {'GB/s':>9} "
+        f"{'p50 ms':>9} {'p99 ms':>9} {'n':>6}  verdict"
+    )
+    for row in rows:
+        verdict = row.get("bound", "unknown")
+        if verdict == "compute":
+            verdict = "compute-bound"
+        elif verdict == "hbm":
+            verdict = "HBM-bound"
+        if row.get("analytic_only"):
+            verdict += " [analytic_only]"
+        mpd = row.get("models_per_dispatch")
+        if mpd:
+            verdict += f" (G={mpd})"
+        lines.append(
+            f"{row.get('program', '?'):<24} "
+            f"{_fmt(row.get('mfu'), '.4f'):>8} "
+            f"{_fmt(row.get('hbm_frac'), '.4f'):>8} "
+            f"{_fmt(row.get('hbm_gbps'), '.2f'):>9} "
+            f"{_fmt(row.get('p50_ms'), '.3f'):>9} "
+            f"{_fmt(row.get('p99_ms'), '.3f'):>9} "
+            f"{_fmt(row.get('count'), 'd'):>6}  {verdict}"
+        )
+    return "\n".join(lines)

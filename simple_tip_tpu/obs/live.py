@@ -99,23 +99,44 @@ def _err(msg: str) -> None:
 
 
 def _stream_paths(target) -> List[str]:
-    """The events-JSONL files of ``target`` (dir(s) or explicit files)."""
+    """The events-JSONL files of ``target`` (dir(s) or explicit files).
+
+    An explicit ``events-*.jsonl`` file operand also pulls in its sibling
+    segments: the tracer rotates to a fresh ``events-<pid>-<n>.jsonl``
+    after compaction (the ``obs.evicted`` marker), and a follow pinned to
+    the pre-rotation segment alone would go silent mid-study. Rescanning
+    the parent directory each poll is what lets ``tail --follow`` ride
+    through rotation.
+    """
     targets = target if isinstance(target, (list, tuple)) else [target]
-    paths = []
+    dirs = []
+    explicit = []
     for t in targets:
         if os.path.isdir(t):
-            try:
-                names = sorted(os.listdir(t))
-            except OSError:
-                continue
-            paths.extend(
-                os.path.join(t, n)
-                for n in names
-                if n.startswith("events-") and n.endswith(".jsonl")
-            )
+            dirs.append(t)
         else:
-            paths.append(t)
-    return paths
+            explicit.append(t)
+            base = os.path.basename(t)
+            if base.startswith("events-") and base.endswith(".jsonl"):
+                dirs.append(os.path.dirname(t) or ".")
+    paths = list(explicit)
+    for d in dirs:
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            continue
+        paths.extend(
+            os.path.join(d, n)
+            for n in names
+            if n.startswith("events-") and n.endswith(".jsonl")
+        )
+    seen = set()
+    unique = []
+    for p in paths:
+        if p not in seen:
+            seen.add(p)
+            unique.append(p)
+    return unique
 
 
 def iter_tail(
@@ -139,9 +160,16 @@ def iter_tail(
     )
     yielded = 0
     while True:
-        for path in _stream_paths(target):
+        live = set(_stream_paths(target))
+        for path in live:
             if path not in cursors:
                 cursors[path] = StreamCursor(path)
+        # Segments the tracer compacted away (no longer listed, gone from
+        # disk) leave dead cursors behind; prune them so a long follow
+        # over many rotations doesn't poll an unbounded stale set.
+        for path in [p for p in cursors if p not in live]:
+            if not os.path.exists(path):
+                del cursors[path]
         batch = []
         for cursor in cursors.values():
             batch.extend(cursor.poll())
@@ -287,6 +315,20 @@ def render_top(snap: dict) -> str:
         lines.append("")
         for k, v in interesting.items():
             lines.append(f"  {k:<40} {v}")
+    # Dispatch counters are the grouped-path liveness signal: a G-sweep
+    # that stopped incrementing group_chain_dispatches is wedged even
+    # while its gauges hold their last value.
+    counters = snap.get("counters", {})
+    dispatch = {
+        k: v
+        for k, v in sorted(counters.items())
+        if k.startswith("run_program.") or k.startswith("serving.")
+    }
+    if dispatch:
+        lines.append("")
+        for k, v in dispatch.items():
+            shown = int(v) if float(v).is_integer() else v
+            lines.append(f"  {k:<40} {shown}")
     return "\n".join(lines)
 
 

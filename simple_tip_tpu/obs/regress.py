@@ -13,7 +13,10 @@ Accepted snapshot forms (auto-detected, mixable):
   table's per-name totals, counters the summed metrics counters;
 - a ``summary --json`` document (``{"spans": ..., "counters": ...}``);
 - a bench record — either ``bench.py``'s raw JSON line or the round
-  driver's ``BENCH_r0*.json`` wrapper (the record under ``"parsed"``).
+  driver's ``BENCH_r0*.json`` wrapper (the record under ``"parsed"``);
+- an ``MFU_BREAKDOWN.json`` device-cost capture (obs/devicemeter):
+  per-program MFUs gate as *floors* (drop-is-bad), per-program p50
+  dispatch seconds as phases (growth-is-bad).
 
 Regression rules (thresholds configurable from the CLI):
 
@@ -88,11 +91,17 @@ def _is_health_counter(name: str) -> bool:
 
 
 def _blank_snapshot(kind: str, source: str) -> dict:
-    """A zeroed snapshot skeleton."""
+    """A zeroed snapshot skeleton.
+
+    ``phases`` gate growth-is-bad (durations, bytes); ``floors`` is the
+    mirror for higher-is-better metrics (MFU, utilization fractions):
+    a floor regresses when the current value DROPS below the band.
+    """
     return {
         "kind": kind,
         "source": source,
         "phases": {},
+        "floors": {},
         "counters": {},
         "degraded": None,
         "value": None,
@@ -149,6 +158,40 @@ def _normalize_bench(doc: dict, source: str) -> dict:
     for label, rate in ((doc.get("serving") or {}).get("rates") or {}).items():
         if isinstance(rate, dict) and isinstance(rate.get("p99_ms"), (int, float)):
             snap["phases"][f"serving.p99.{label}"] = float(rate["p99_ms"]) / 1000.0
+    # Device-cost observatory: the record's headline MFU (and any
+    # per-program MFUs the devicemeter companion graded) gate as FLOORS —
+    # a chip-utilization drop fails trend exactly like a p99 growth.
+    if isinstance(doc.get("mfu"), (int, float)) and doc["mfu"] > 0:
+        snap["floors"]["mfu"] = float(doc["mfu"])
+    for section in ("fused_chain", "grouped_chain"):
+        programs = (doc.get(section) or {}).get("device_cost") or {}
+        if not isinstance(programs, dict):
+            continue
+        for prog, graded in programs.items():
+            if isinstance(graded, dict) and isinstance(
+                graded.get("mfu"), (int, float)
+            ):
+                snap["floors"][f"mfu.{prog}"] = float(graded["mfu"])
+    return snap
+
+
+def _normalize_mfu_breakdown(doc: dict, source: str) -> dict:
+    """An ``MFU_BREAKDOWN.json`` capture (obs/devicemeter) as a snapshot:
+    per-program MFUs become floors (drop-is-bad), per-program p50 dispatch
+    seconds become phases (growth-is-bad), so one healthy-window capture
+    series is trend-gated on both axes."""
+    snap = _blank_snapshot("mfu_breakdown", source)
+    snap["degraded"] = bool(doc.get("degraded", False))
+    for name, entry in sorted((doc.get("programs") or {}).items()):
+        if not isinstance(entry, dict):
+            continue
+        graded = entry.get("grade") or {}
+        if isinstance(graded.get("mfu"), (int, float)):
+            snap["floors"][f"mfu.{name}"] = float(graded["mfu"])
+        dispatch = entry.get("dispatch_s") or {}
+        p50 = dispatch.get("p50", dispatch.get("mean"))
+        if isinstance(p50, (int, float)):
+            snap["phases"][f"dispatch.{name}"] = float(p50)
     return snap
 
 
@@ -239,6 +282,9 @@ def load_snapshot(target) -> dict:
             snap["degraded"] = bool(doc.get("degraded"))
         return snap
 
+    if doc.get("kind") == "mfu_breakdown":  # MFU_BREAKDOWN.json capture
+        return _normalize_mfu_breakdown(doc, str(target))
+
     if "metric" in doc and "value" in doc:  # bench record
         return _normalize_bench(doc, str(target))
 
@@ -314,6 +360,20 @@ def compare(
         )
         row(
             "bench", "value", baseline["value"], current["value"], dropped,
+            f"> -{max_growth:.0%} drop" if dropped else "",
+        )
+
+    base_floors = baseline.get("floors") or {}
+    cur_floors = current.get("floors") or {}
+    for name in sorted(set(base_floors) | set(cur_floors)):
+        base = base_floors.get(name)
+        cur = cur_floors.get(name)
+        if base is None or cur is None:
+            row("floor", name, base, cur, False, "only in one snapshot")
+            continue
+        dropped = base > 0 and cur < base * (1.0 - max_growth)
+        row(
+            "floor", name, base, cur, dropped,
             f"> -{max_growth:.0%} drop" if dropped else "",
         )
 
@@ -574,6 +634,24 @@ def trend(
                 "bench", "value", None, None, current["value"], False,
                 "not enough history",
             )
+
+    # Floors (MFU and friends): drop-is-bad, the mirror of the bench value
+    # gate — a utilization collapse on an otherwise-fast run still fails.
+    for name in sorted(current.get("floors") or {}):
+        cur = current["floors"][name]
+        history = [
+            (s.get("floors") or {}).get(name) for s in baseline
+        ]
+        history = [v for v in history if isinstance(v, (int, float))]
+        if len(history) < min_baseline:
+            row("floor", name, None, None, cur, False, "not enough history")
+            continue
+        med, half = _band(history, band, rel_floor)
+        dropped = cur < med - half
+        row(
+            "floor", name, med, half, cur, dropped,
+            "below trend band" if dropped else "",
+        )
 
     if current["degraded"] is not None:
         flip = current["degraded"] is True

@@ -17,7 +17,8 @@ Row schema (``schema`` is the version stamp; the ``unversioned-schema``
 tiplint rule enforces that every obs JSONL writer carries one):
 
 - identity: ``schema``, ``kind`` (``obs_run`` | ``bench`` | ``host_phase``
-  | ``multichip``), ``source`` (path), ``seq`` (append batch, newest wins),
+  | ``multichip`` | ``mfu_breakdown``), ``source`` (path), ``seq``
+  (append batch, newest wins),
   ``run`` (model id / round / capture label; None for aggregates),
   ``phase`` (span name / bench metric);
 - target: ``seconds`` (what the cost model fits) or ``value`` (bench
@@ -57,6 +58,7 @@ _RUN_SPAN = "run"
 _RECORD_PREFIXES = (
     ("BENCH_r", "bench"),
     ("MULTICHIP_r", "multichip"),
+    ("MFU_BREAKDOWN", "mfu_breakdown"),
 )
 
 
@@ -102,6 +104,8 @@ def _classify_file(path: str):
     if isinstance(doc, dict):
         if isinstance(doc.get("parsed"), dict):
             doc = doc["parsed"]
+        if doc.get("kind") == "mfu_breakdown":
+            return "mfu_breakdown"
         if "metric" in doc and "value" in doc:
             return "bench"
         # Renamed HOST_PHASE captures (trend fixtures, archived
@@ -118,7 +122,8 @@ def discover_sources(roots) -> list:
     A directory is scanned one level deep: obs run dirs (any subdirectory
     holding ``events-*.jsonl``, including the root itself), plus
     ``BENCH_r*.json`` / ``HOST_PHASE.json`` / ``MULTICHIP_r*.json`` /
-    recognizable bench-record files directly inside it. The index directory
+    ``MFU_BREAKDOWN*.json`` / recognizable bench-record files directly
+    inside it. The index directory
     itself is never a source (the store must not eat its own output).
     """
     found = {}
@@ -388,6 +393,26 @@ def _rows_from_bench(path: str, seq: int) -> list:
                     row["value"] = float(entry[field])
                     row["group"] = g
                     rows.append(row)
+    # Devicemeter companion: the record's headline MFU plus any per-program
+    # grades ride as ``mfu.*`` value rows — the cost-analysis features the
+    # costmodel corpus can learn utilization terms from.
+    if isinstance(doc.get("mfu"), (int, float)) and doc["mfu"] > 0:
+        row = base()
+        row["phase"] = "mfu"
+        row["value"] = float(doc["mfu"])
+        rows.append(row)
+    for section in ("fused_chain", "grouped_chain"):
+        programs = (doc.get(section) or {}).get("device_cost") or {}
+        if not isinstance(programs, dict):
+            continue
+        for prog, graded in sorted(programs.items()):
+            if isinstance(graded, dict) and isinstance(
+                graded.get("mfu"), (int, float)
+            ):
+                row = base()
+                row["phase"] = f"mfu.{prog}"
+                row["value"] = float(graded["mfu"])
+                rows.append(row)
     # Serving companion (schema 1): per-arrival-rate SLO features so the
     # learned cost model and the trend gate see the online path.
     serving = doc.get("serving") or {}
@@ -504,11 +529,61 @@ def _rows_from_multichip(path: str, seq: int) -> list:
     return [row]
 
 
+def _rows_from_mfu_breakdown(path: str, seq: int) -> list:
+    """Feature rows of one ``MFU_BREAKDOWN.json`` device-cost capture:
+    one ``mfu.<program>`` value row (the trend-gated floor feature) plus
+    one ``dispatch.<program>`` seconds row per graded program. Grouped
+    G-sweep entries carry their ``models_per_dispatch`` as ``group``."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return []
+    if not isinstance(doc, dict) or doc.get("kind") != "mfu_breakdown":
+        return []
+    run = os.path.splitext(os.path.basename(path))[0]
+    rows = []
+
+    def base():
+        row = _blank_row("mfu_breakdown", path, seq)
+        row["run"] = run
+        row["platform"] = doc.get("platform")
+        row["degraded"] = bool(doc.get("degraded", False))
+        row["captured"] = doc.get("captured_unix")
+        return row
+
+    for prog, entry in sorted((doc.get("programs") or {}).items()):
+        if not isinstance(entry, dict):
+            continue
+        graded = entry.get("grade") or {}
+        cost = entry.get("cost") or {}
+        group = entry.get("models_per_dispatch")
+        dispatch = entry.get("dispatch_s") or {}
+        if isinstance(graded.get("mfu"), (int, float)):
+            row = base()
+            row["phase"] = f"mfu.{prog}"
+            row["value"] = float(graded["mfu"])
+            row["group"] = group
+            if isinstance(cost.get("peak_memory_bytes"), (int, float)):
+                row["device_peak_bytes"] = int(cost["peak_memory_bytes"])
+            rows.append(row)
+        p50 = dispatch.get("p50", dispatch.get("mean"))
+        if isinstance(p50, (int, float)):
+            row = base()
+            row["phase"] = f"dispatch.{prog}"
+            row["seconds"] = float(p50)
+            row["count"] = dispatch.get("count", 1)
+            row["group"] = group
+            rows.append(row)
+    return rows
+
+
 _NORMALIZERS = {
     "obs_run": _rows_from_obs_run,
     "bench": _rows_from_bench,
     "host_phase": _rows_from_host_phase,
     "multichip": _rows_from_multichip,
+    "mfu_breakdown": _rows_from_mfu_breakdown,
 }
 
 

@@ -98,6 +98,7 @@ class AdmissionController:
         queued_rows: int,
         live_ewma_s: Optional[float] = None,
         count_shed: bool = True,
+        request_id: Optional[str] = None,
     ) -> Verdict:
         """Admit (returning a :class:`Verdict`) or raise.
 
@@ -138,6 +139,7 @@ class AdmissionController:
                 f"queue bound: {queued_rows}+{n_rows} rows > "
                 f"{self.knobs.queue_bound_rows}",
                 count=count_shed,
+                request_id=request_id,
             )
         if (
             self.knobs.backlog_bound_s
@@ -149,6 +151,7 @@ class AdmissionController:
                 f"predicted backlog {backlog_s:.3f}s > "
                 f"{self.knobs.backlog_bound_s:.3f}s bound",
                 count=count_shed,
+                request_id=request_id,
             )
         obs.counter("serving.admitted").inc()
         return Verdict(degraded=degraded, backlog_s=backlog_s)
@@ -160,12 +163,15 @@ class AdmissionController:
         queued_rows: Optional[int] = None,
         backlog_s: Optional[float] = None,
         reason: str = "",
+        request_id: Optional[str] = None,
     ) -> None:
         """The loud part of one shed: counters + event + error-level log.
 
         Called by ``check`` for a directly-rejected request, and by the
         engine for rejections it decides itself (an evicted request in
         ``shed_mode=oldest``, or the incoming one when eviction failed).
+        ``request_id`` (when the caller minted one) rides the event so a
+        shed greps out of the stream by the same token as a dispatch.
         """
         obs.counter("serving.shed").inc()
         obs.counter("serving.shed_rows").inc(n_rows)
@@ -180,17 +186,20 @@ class AdmissionController:
                 if backlog_s is not None
                 else {}
             ),
+            **({"request_id": request_id} if request_id else {}),
         )
         logger.warning(
             "serving SHED %d row(s) for model %r (%s)", n_rows, model, reason
         )
 
     def _shed(
-        self, model, n_rows, queued_rows, backlog_s, reason: str, count: bool
+        self, model, n_rows, queued_rows, backlog_s, reason: str, count: bool,
+        request_id: Optional[str] = None,
     ) -> None:
         """Raise one shed (the 429 path), loudly unless this is a probe."""
         if count:
-            self.count_shed(model, n_rows, queued_rows, backlog_s, reason)
+            self.count_shed(model, n_rows, queued_rows, backlog_s, reason,
+                            request_id=request_id)
         raise RequestShed(
             f"request shed for model {model!r}: {reason}",
             retry_after_s=backlog_s,

@@ -44,15 +44,29 @@ class Chunk:
 
 
 class Badge:
-    """One assembled dispatch unit: chunks, row count, and fill ratio."""
+    """One assembled dispatch unit: chunks, row count, and fill ratio.
 
-    __slots__ = ("model", "chunks", "rows", "fill")
+    ``request_ids`` collects the distinct request ids riding the badge
+    (in chunk order) when the opaque request handles carry one — the
+    engine stamps them on the dispatch span so a request's admission
+    event and its badge correlate by id. Handles without the attribute
+    (tests driving the batcher directly) simply contribute nothing.
+    """
+
+    __slots__ = ("model", "chunks", "rows", "fill", "request_ids")
 
     def __init__(self, model, chunks: List[Chunk], max_badge: int):
         self.model = model
         self.chunks = chunks
         self.rows = sum(c.n for c in chunks)
         self.fill = self.rows / float(max_badge)
+        seen = set()
+        self.request_ids: List[str] = []
+        for c in chunks:
+            rid = getattr(c.request, "request_id", None)
+            if rid and rid not in seen:
+                seen.add(rid)
+                self.request_ids.append(rid)
 
 
 class ContinuousBatcher:

@@ -26,6 +26,7 @@ subsystem): ``serving.request_ms`` quantile (p50/p95/p99),
 """
 
 import asyncio
+import itertools
 import logging
 from typing import Dict, List, Optional
 
@@ -41,14 +42,17 @@ logger = logging.getLogger(__name__)
 class _Request:
     """One submitted request: chunk bookkeeping + the response future."""
 
-    __slots__ = ("model", "future", "t_enqueue", "parts", "pending")
+    __slots__ = ("model", "future", "t_enqueue", "parts", "pending",
+                 "request_id")
 
-    def __init__(self, model, future, t_enqueue: float, n_chunks: int):
+    def __init__(self, model, future, t_enqueue: float, n_chunks: int,
+                 request_id: Optional[str] = None):
         self.model = model
         self.future = future
         self.t_enqueue = t_enqueue
         self.parts: List = [None] * n_chunks
         self.pending = n_chunks
+        self.request_id = request_id
 
     def fail(self, exc: BaseException) -> None:
         """Reject the request (idempotent across its chunks)."""
@@ -110,6 +114,11 @@ class ScoringEngine:
         self._closed = False
         self._ewma_badge_s: Dict[object, float] = {}
         self._had_backend_failure = False
+        # Monotonic per-engine request ids, stamped on every admission
+        # outcome (shed events included) and on each badge's dispatch
+        # span, so one request's path — admit, coalesce, dispatch or
+        # shed — greps out of the event stream by a single token.
+        self._rid = itertools.count(1)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -203,11 +212,14 @@ class ScoringEngine:
         n = len(rows)
         if n == 0:
             raise ValueError("empty request")
-        self._admit(model, n)
+        # Minted before admission so even a shed carries the id.
+        rid = f"r{next(self._rid):06d}"
+        self._admit(model, n, request_id=rid)
         loop = asyncio.get_running_loop()
         now = loop.time()
         bounds = list(range(0, n, self.knobs.max_badge)) + [n]
-        req = _Request(model, loop.create_future(), now, len(bounds) - 1)
+        req = _Request(model, loop.create_future(), now, len(bounds) - 1,
+                       request_id=rid)
         for i in range(len(bounds) - 1):
             self.batcher.push(
                 model,
@@ -218,7 +230,7 @@ class ScoringEngine:
         parts = await req.future
         return self.executor.merge(parts)
 
-    def _admit(self, model, n: int) -> None:
+    def _admit(self, model, n: int, request_id: Optional[str] = None) -> None:
         """Admission gate, honoring ``shed_mode=oldest`` eviction."""
         oldest = self.knobs.shed_mode == "oldest"
         try:
@@ -229,6 +241,7 @@ class ScoringEngine:
                 model, n, self.batcher.pending_rows(model),
                 live_ewma_s=self._ewma_badge_s.get(model),
                 count_shed=not oldest,
+                request_id=request_id,
             )
         except RequestShed as shed:
             if not oldest:
@@ -248,11 +261,13 @@ class ScoringEngine:
                     queued_rows=self.batcher.pending_rows(model),
                     backlog_s=shed.retry_after_s,
                     reason="no evictable request to make room",
+                    request_id=request_id,
                 )
                 raise
             verdict = self.admission.check(
                 model, n, self.batcher.pending_rows(model),
                 live_ewma_s=self._ewma_badge_s.get(model),
+                request_id=request_id,
             )
         if verdict.degraded:
             # stamped on the request too, so response-side telemetry can
@@ -271,6 +286,7 @@ class ScoringEngine:
                 req.model, rows,
                 backlog_s=shed.retry_after_s,
                 reason="evicted-oldest",
+                request_id=getattr(req, "request_id", None),
             )
             req.fail(
                 RequestShed(
@@ -345,11 +361,13 @@ class ScoringEngine:
     def _run_badge_sync(self, badge: Badge):
         """Sync badge dispatch (worker thread): span + retry + breaker."""
         br = self.admission.breaker
+        rids = getattr(badge, "request_ids", ()) or ()
         with obs.span(
             "serving.badge",
             model=str(badge.model),
             rows=badge.rows,
             fill=round(badge.fill, 4),
+            **({"request_ids": ",".join(rids)} if rids else {}),
         ):
             try:
                 parts = self.retry.call(
@@ -400,11 +418,13 @@ class ScoringEngine:
     def _settle_failure(self, badge: Badge, exc: Exception) -> None:
         """Reject every request riding a failed badge (typed + counted)."""
         obs.counter("serving.backend_errors").inc()
+        rids = getattr(badge, "request_ids", ()) or ()
         obs.event(
             "serving.backend_error",
             model=str(badge.model),
             rows=badge.rows,
             error=repr(exc)[:200],
+            **({"request_ids": ",".join(rids)} if rids else {}),
         )
         logger.error(
             "serving badge failed for model %r (%d rows): %r",

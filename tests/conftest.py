@@ -59,6 +59,16 @@ for _var in ["TIP_FUSED_CHAIN", "TIP_INT8_PROFILES", "TIP_CHAIN_GROUP"] + [
 ]:
     os.environ.pop(_var, None)
 
+# An inherited device-peak override would regrade every MFU the meter
+# tests pin against the bundled v4/CPU tables (a developer calibrating a
+# new chip exports one); the healthy-window pilot knobs would reshape the
+# poll cadence/deadline the capture tests assume. Cleared here; the
+# override is opted into per-test via monkeypatch.
+for _var in ["TIP_DEVICE_PEAKS", "TIP_HEALTHZ_URL"] + [
+    v for v in os.environ if v.startswith("TIP_HEALTHY_")
+]:
+    os.environ.pop(_var, None)
+
 # An inherited TIP_PLAN_FILE would silently activate an ExecutionPlan under
 # every scheduler/serving/bench test (plan-based estimates replacing the
 # cost-model fallbacks the tests pin); the other TIP_PLAN_* knobs would

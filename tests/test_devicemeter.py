@@ -1,0 +1,365 @@
+"""Device cost observatory (obs/devicemeter.py) contract tests.
+
+The meter math is stdlib-only, so everything here runs on synthetic
+cost_analysis dicts — no jax, no compiled executable:
+
+- ``normalize_cost`` tolerates every historical cost_analysis shape;
+- ``grade`` MFU/HBM arithmetic is pinned against hand-computed values;
+  unknown chips grade ``analytic_only`` (achieved rates present, MFU
+  withheld) and ``TIP_DEVICE_PEAKS`` overrides the peak table;
+- the program-cost registry round-trips and ``observe_dispatch`` lands
+  per-program gauges/quantiles that the Prometheus exporter renders
+  (the ``/metrics`` half of the observatory) and ``obs top`` shows the
+  dispatch counters (the CLI half);
+- ``build_breakdown`` documents feed the feature store (``mfu.*`` rows),
+  the roofline renderer, and — via the committed
+  ``tests/fixtures/mfu_trend`` series — the ``obs trend`` MFU floor
+  gate: the stable tail passes, the MFU-drop tail fails naming the
+  ``mfu.chain`` floor;
+- ``obs tail`` discovers rotated sibling segments from an explicit-file
+  operand, and the serving stack propagates ``request_id`` from
+  admission (shed events included) through badge assembly.
+"""
+
+import json
+import os
+
+import pytest
+
+import simple_tip_tpu.obs as obs
+from simple_tip_tpu.obs import devicemeter
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MFU_FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "mfu_trend")
+
+
+@pytest.fixture(autouse=True)
+def _meter_isolation():
+    """Fresh program-cost registry around every test."""
+    devicemeter.reset()
+    yield
+    devicemeter.reset()
+
+
+# --- cost normalization ------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "raw, want",
+    [
+        (
+            {"flops": 100.0, "bytes accessed": 50.0, "optimal seconds": 0.1},
+            {"flops": 100.0, "bytes_accessed": 50.0, "optimal_seconds": 0.1},
+        ),
+        ([{"flops": 7}, {"flops": 9}], {"flops": 7.0}),  # first device wins
+        ({"flops": "junk", "bytes_accessed": 8}, {"bytes_accessed": 8.0}),
+        ({"unrelated key": 3.0}, None),
+        ({"flops": -5.0}, None),  # junk negatives dropped
+        ({}, None),
+        ("not a dict", None),
+        (None, None),
+        ([], None),
+    ],
+)
+def test_normalize_cost_tolerates_every_shape(raw, want):
+    assert devicemeter.normalize_cost(raw) == want
+
+
+# --- grading -----------------------------------------------------------------
+
+
+def test_grade_mfu_math_pinned_on_v4():
+    # 2.75e12 FLOPs in 0.1 s = 27.5 TFLOP/s = exactly 10% of the 275
+    # TFLOP/s bf16 peak; 1.228e10 B in 0.1 s = 10% of 1228 GB/s.
+    g = devicemeter.grade(
+        {"flops": 2.75e12, "bytes_accessed": 1.228e10},
+        0.1,
+        platform="tpu",
+        device_kind="TPU v4",
+    )
+    assert g["mfu"] == pytest.approx(0.1)
+    assert g["hbm_frac"] == pytest.approx(0.1)
+    assert g["achieved_flops_per_s"] == pytest.approx(2.75e13)
+    assert g["bound"] == "compute"  # tie resolves compute-ward
+    assert not g["analytic_only"]
+    assert g["peak_label"] == "tpu-v4-bf16"
+
+
+def test_grade_hbm_bound_verdict():
+    g = devicemeter.grade(
+        {"flops": 1e9, "bytes_accessed": 6.14e9},  # hbm_frac 0.5 >> mfu
+        0.01,
+        platform="tpu",
+        device_kind="TPU v4",
+    )
+    assert g["hbm_frac"] == pytest.approx(0.5)
+    assert g["bound"] == "hbm"
+
+
+def test_unknown_chip_grades_analytic_only():
+    g = devicemeter.grade(
+        {"flops": 1e9}, 0.01, platform="tpu", device_kind="TPU v99"
+    )
+    assert g["analytic_only"] is True
+    assert g["mfu"] is None and g["hbm_frac"] is None
+    assert g["bound"] == "unknown"
+    # achieved rates need no peak table: they must survive
+    assert g["achieved_flops_per_s"] == pytest.approx(1e11)
+    assert g["peak_label"] == "unknown:TPU v99"
+
+
+def test_device_peaks_env_override(monkeypatch):
+    monkeypatch.setenv(
+        "TIP_DEVICE_PEAKS",
+        json.dumps({"v99": {"flops_per_s": 1e12, "hbm_bytes_per_s": 1e11,
+                            "label": "lab-v99"}}),
+    )
+    g = devicemeter.grade(
+        {"flops": 1e9}, 0.01, platform="tpu", device_kind="TPU v99"
+    )
+    assert not g["analytic_only"]
+    assert g["mfu"] == pytest.approx(0.1)
+    assert g["peak_label"] == "lab-v99"
+
+
+def test_device_peaks_malformed_env_is_ignored(monkeypatch):
+    monkeypatch.setenv("TIP_DEVICE_PEAKS", "{not json")
+    peaks = devicemeter.resolve_peaks("tpu", "TPU v4")
+    assert peaks["label"] == "tpu-v4-bf16"  # bundled table still applies
+
+
+def test_cpu_peaks_scale_with_cores():
+    one = devicemeter.resolve_peaks("cpu", "cpu", cores=1)
+    eight = devicemeter.resolve_peaks("cpu", "cpu", cores=8)
+    assert eight["flops_per_s"] == pytest.approx(8 * one["flops_per_s"])
+    assert eight["hbm_bytes_per_s"] == one["hbm_bytes_per_s"]
+
+
+def test_grade_without_timing_reports_cost_only():
+    g = devicemeter.grade({"flops": 1e9}, None, platform="cpu", device_kind="cpu")
+    assert g["flops"] == 1e9
+    assert g["mfu"] is None and g["bound"] == "unknown"
+
+
+# --- registry + live attribution --------------------------------------------
+
+
+def test_program_cost_registry_roundtrip():
+    devicemeter.record_program_cost("chain", {"flops": 5.0}, fingerprint="abc")
+    assert devicemeter.program_cost("chain") == {"flops": 5.0}
+    assert devicemeter.program_costs()["chain"]["fingerprint"] == "abc"
+    # None cost pops: a later hit cannot resurrect a stale entry
+    devicemeter.record_program_cost("chain", None)
+    assert devicemeter.program_cost("chain") is None
+
+
+def test_observe_dispatch_lands_gauges_and_exporter_renders_them():
+    from simple_tip_tpu.obs import exporter
+
+    obs.reset_all()
+    devicemeter.record_program_cost(
+        "chain", {"flops": 2.75e12, "bytes_accessed": 1.228e10}
+    )
+    devicemeter.observe_dispatch(
+        "chain", 0.1, platform="tpu", device_kind="TPU v4"
+    )
+    snap = obs.metrics_snapshot()
+    assert snap["gauges"]["run_program.mfu.chain"] == pytest.approx(0.1)
+    assert snap["gauges"]["run_program.hbm_frac.chain"] == pytest.approx(0.1)
+    assert snap["quantiles"]["run_program.dispatch_s.chain"]["count"] == 1
+    # the exporter renders the whole registry: the observatory's gauges
+    # and latency quantiles reach /metrics with zero exporter changes
+    text = exporter.render_metrics(snap)
+    assert "tip_run_program_mfu_chain" in text
+    assert 'tip_run_program_dispatch_s_chain{quantile="0.5"}' in text
+    obs.reset_all()
+
+
+def test_observe_dispatch_without_cost_lands_quantile_only():
+    obs.reset_all()
+    devicemeter.observe_dispatch("rank", 0.02, platform="cpu", device_kind="cpu")
+    snap = obs.metrics_snapshot()
+    assert snap["quantiles"]["run_program.dispatch_s.rank"]["count"] == 1
+    assert "run_program.mfu.rank" not in snap["gauges"]
+    obs.reset_all()
+
+
+def test_rows_from_metrics_derives_verdicts():
+    rows = devicemeter.rows_from_metrics(
+        {
+            "gauges": {
+                "run_program.mfu.chain": 0.3,
+                "run_program.hbm_frac.chain": 0.1,
+            },
+            "quantiles": {
+                "run_program.dispatch_s.chain": {"count": 4, "p50": 0.01,
+                                                 "p95": 0.012, "p99": 0.013}
+            },
+        }
+    )
+    (row,) = rows
+    assert row["program"] == "chain"
+    assert row["bound"] == "compute"
+    assert row["p50_ms"] == pytest.approx(10.0)
+
+
+# --- MFU_BREAKDOWN documents -------------------------------------------------
+
+
+def _breakdown():
+    return devicemeter.build_breakdown(
+        {
+            "chain": {
+                "cost": {"flops": 8.25e11, "bytes_accessed": 2.0e9},
+                "dispatch_s": {"count": 40, "p50": 0.01, "p95": 0.012,
+                               "p99": 0.013},
+            },
+            "group_chain@g4": {
+                "cost": {"flops": 3.3e12, "bytes_accessed": 8.0e9},
+                "dispatch_s": 0.04,
+                "models_per_dispatch": 4,
+            },
+        },
+        platform="tpu",
+        device_kind="TPU v4",
+        captured_unix=1754500000.0,
+    )
+
+
+def test_build_breakdown_is_schema_stamped_and_graded():
+    doc = _breakdown()
+    assert doc["schema"] == devicemeter.SCHEMA
+    assert doc["kind"] == "mfu_breakdown"
+    assert doc["captured_unix"] == 1754500000.0
+    chain = doc["programs"]["chain"]
+    assert chain["grade"]["mfu"] == pytest.approx(0.3)
+    g4 = doc["programs"]["group_chain@g4"]
+    assert g4["models_per_dispatch"] == 4
+    assert g4["dispatch_s"] == {"mean": 0.04}  # scalar timing normalized
+    assert json.loads(json.dumps(doc)) == doc  # JSON-safe throughout
+
+
+def test_render_roofline_marks_verdicts_and_gsweep():
+    text = devicemeter.render_roofline(
+        devicemeter.rows_from_breakdown(_breakdown())
+    )
+    assert "compute-bound" in text
+    assert "(G=4)" in text
+
+
+def test_store_indexes_breakdown_into_mfu_rows(tmp_path):
+    from simple_tip_tpu.obs import store
+
+    src = tmp_path / "capture"
+    src.mkdir()
+    (src / "MFU_BREAKDOWN.json").write_text(json.dumps(_breakdown()))
+    index = tmp_path / "index"
+    store.refresh([str(src)], str(index))
+    rows = store.load_rows(str(index))
+    by_phase = {r["phase"]: r for r in rows}
+    assert by_phase["mfu.chain"]["value"] == pytest.approx(0.3, rel=1e-3)
+    assert by_phase["mfu.group_chain@g4"]["group"] == 4
+    assert by_phase["dispatch.chain"]["seconds"] == pytest.approx(0.01)
+
+
+# --- the trend gate over the committed fixtures ------------------------------
+
+
+def _trend(tail_name):
+    from simple_tip_tpu.obs import regress
+
+    paths = [
+        os.path.join(MFU_FIXTURES, name)
+        for name in ("m01.json", "m02.json", "m03.json", "m04.json", tail_name)
+    ]
+    return regress.trend([regress.load_snapshot(p) for p in paths])
+
+
+def test_mfu_trend_stable_tail_passes():
+    result = _trend("m05_stable.json")
+    assert result["ok"], result["regressions"]
+
+
+def test_mfu_trend_drop_trips_the_floor():
+    result = _trend("m05_drop.json")
+    assert not result["ok"]
+    tripped = {r["name"] for r in result["regressions"]}
+    assert "mfu.chain" in tripped
+    # the sibling program held its utilization: attribution is per-program
+    assert "mfu.group_chain@g4" not in tripped
+
+
+# --- live-surface satellites -------------------------------------------------
+
+
+def test_render_top_shows_dispatch_counters():
+    from simple_tip_tpu.obs import live
+
+    snap = {
+        "phases": {},
+        "gauges": {},
+        "counters": {
+            "run_program.group_chain_dispatches": 12.0,
+            "run_program.group_rank_dispatches": 6.0,
+            "program_cache.hit": 3.0,  # not a dispatch surface: hidden
+        },
+    }
+    text = live.render_top(snap)
+    assert "run_program.group_chain_dispatches" in text
+    assert "run_program.group_rank_dispatches" in text
+    assert "program_cache.hit" not in text
+
+
+def test_tail_explicit_file_discovers_rotated_siblings(tmp_path):
+    from simple_tip_tpu.obs import live
+
+    first = tmp_path / "events-1-0.jsonl"
+    rotated = tmp_path / "events-1-1.jsonl"
+    first.write_text(json.dumps({"ts": 1.0, "pid": 1, "type": "event",
+                                 "name": "before-rotation"}) + "\n")
+    rotated.write_text(json.dumps({"ts": 2.0, "pid": 1, "type": "event",
+                                   "name": "after-rotation"}) + "\n")
+    names = [rec["name"] for rec in live.iter_tail(str(first))]
+    assert names == ["before-rotation", "after-rotation"]
+
+
+# --- request-id propagation (serving) ---------------------------------------
+
+
+def test_badge_collects_request_ids_in_chunk_order():
+    from simple_tip_tpu.serving.batcher import Badge, Chunk
+
+    class Handle:
+        def __init__(self, rid):
+            self.request_id = rid
+
+    a, b = Handle("r000001"), Handle("r000002")
+    chunks = [Chunk(a, 0, None, 4, 0.0), Chunk(b, 0, None, 4, 0.0),
+              Chunk(a, 1, None, 4, 0.0)]
+    badge = Badge("m", chunks, max_badge=16)
+    assert badge.request_ids == ["r000001", "r000002"]  # deduped, ordered
+    # opaque handles without the attribute contribute nothing (old tests)
+    badge = Badge("m", [Chunk(object(), 0, None, 4, 0.0)], max_badge=16)
+    assert badge.request_ids == []
+
+
+def test_shed_event_carries_request_id(tmp_path, monkeypatch):
+    from simple_tip_tpu.obs.cli import load_events
+    from simple_tip_tpu.serving.admission import AdmissionController
+    from simple_tip_tpu.serving.errors import RequestShed
+    from simple_tip_tpu.serving.knobs import ServingKnobs
+
+    monkeypatch.setenv("TIP_OBS_DIR", str(tmp_path / "obsrun"))
+    obs.reset_all()
+    try:
+        knobs = ServingKnobs(queue_bound_rows=8)
+        ctl = AdmissionController(knobs, breaker=None)
+        with pytest.raises(RequestShed):
+            ctl.check("m", 16, 0, request_id="r000042")
+        obs.flush_metrics()
+    finally:
+        events, _files, _bad = load_events(str(tmp_path / "obsrun"))
+        obs.reset_all()
+    shed = [e for e in events
+            if e.get("type") == "event" and e.get("name") == "serving.shed"]
+    assert shed and shed[0]["attrs"]["request_id"] == "r000042"
