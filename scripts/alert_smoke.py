@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""CI smoke for the SLO/alerting plane (obs/slo.py + obs/alerts.py).
+
+Dependency-free by design (stdlib only — no jax, no numpy): replays the
+committed fixture metrics trajectory
+(``tests/fixtures/alert_smoke/trajectory.jsonl`` — a breaker-open breach
+riding on a steady 25% shed rate) through a real :class:`Evaluator`
+under the committed rule document (``rules.json``) and pins the whole
+Google-SRE multi-window story end to end:
+
+- the fast-burn ``fast-breaker`` page goes inactive→pending→firing
+  during the breach and resolved after recovery — exactly one firing
+  and one resolved transition in ``alerts.jsonl``;
+- the slow-burn ``slow-shed`` warn goes pending (slow window hot) and
+  STAYS pending — a slow leak never pages;
+- the firing opens exactly one incident; recovery closes it with a
+  duration and the budget burned;
+- CLI exit codes are pinned like devicemeter_smoke: ``obs alerts``
+  exits 1 mid-firing, 0 after resolution, 3 against a state directory
+  no evaluator ever wrote; ``obs incidents`` exits 0 once all incidents
+  are closed.
+
+Exit 0 on success, 1 with a diagnostic on the first failed check.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "alert_smoke")
+BASE_TS = 1_700_000_000.0  # synthetic clock origin for the replay
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def _snap(rec):
+    return {
+        "counters": rec.get("counters", {}),
+        "gauges": rec.get("gauges", {}),
+        "histograms": {},
+    }
+
+
+def main() -> int:  # noqa: PLR0911, PLR0912 — a smoke is a list of checks
+    os.environ.pop("TIP_OBS_DIR", None)  # no event stream: sinks only
+    os.environ["TIP_ALERT_SINKS"] = "jsonl"
+    from simple_tip_tpu.obs import alerts, slo
+    from simple_tip_tpu.obs.cli import main as obs_main
+
+    with open(os.path.join(FIXTURES, "trajectory.jsonl")) as f:
+        ticks = [json.loads(line) for line in f if line.strip()]
+    os.environ[slo.RULES_ENV] = "@" + os.path.join(FIXTURES, "rules.json")
+    rules_doc = slo.load_rules()
+    if not rules_doc or len(rules_doc["rules"]) != 2:
+        return _fail(f"fixture rule document failed to load: {rules_doc!r}")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        state = os.path.join(tmp, "alerts")
+
+        # -- exit 3: no evaluator has ever written this state dir ---------
+        if obs_main(["alerts", "--state", state]) != 3:
+            return _fail("`obs alerts` against absent state must exit 3")
+        if obs_main(["incidents", "--state", state]) != 3:
+            return _fail("`obs incidents` against absent state must exit 3")
+
+        ev = alerts.Evaluator(
+            rules_doc=rules_doc, state_dir=state, min_interval_s=0.0
+        )
+        transitions = []
+        checked_mid_firing = False
+        for rec in ticks:
+            transitions += ev.evaluate(_snap(rec), now=BASE_TS + rec["t"])
+            firing_now = any(
+                r["state"] == "firing" for r in ev.view()["rules"]
+            )
+            if firing_now and not checked_mid_firing:
+                checked_mid_firing = True
+                if obs_main(["alerts", "--state", state]) != 1:
+                    return _fail("`obs alerts` mid-firing must exit 1")
+
+        # -- the fast-burn page: one firing, one resolve, in order --------
+        path = [(t["rule"], t["to"]) for t in transitions]
+        breaker_path = [to for rule, to in path if rule == "fast-breaker"]
+        if breaker_path != ["pending", "firing", "resolved"]:
+            return _fail(
+                f"fast-breaker expected pending->firing->resolved, "
+                f"got {breaker_path}"
+            )
+        if not checked_mid_firing:
+            return _fail("the firing window was never observed mid-replay")
+
+        # -- the slow-burn warn: pending at end, never fired --------------
+        shed_path = [to for rule, to in path if rule == "slow-shed"]
+        if "firing" in shed_path:
+            return _fail(f"slow-shed (slow burn only) must never fire: {shed_path}")
+        shed_state = [
+            r for r in ev.view()["rules"] if r["rule"] == "slow-shed"
+        ][0]["state"]
+        if shed_state != "pending":
+            return _fail(f"slow-shed expected to end pending, got {shed_state}")
+
+        # -- the jsonl sink: exactly one firing + one resolved line -------
+        with open(alerts.alerts_log_path(state)) as f:
+            logged = [json.loads(line) for line in f]
+        n_firing = sum(1 for r in logged if r["to"] == "firing")
+        n_resolved = sum(1 for r in logged if r["to"] == "resolved")
+        if (n_firing, n_resolved) != (1, 1):
+            return _fail(
+                f"alerts.jsonl expected exactly 1 firing + 1 resolved, "
+                f"got {n_firing} + {n_resolved}"
+            )
+        if any(r.get("schema") != alerts.SCHEMA for r in logged):
+            return _fail("every alerts.jsonl record must be schema-stamped")
+
+        # -- the incident: opened by the firing, closed by the resolve ----
+        open_incs, closed = alerts.load_incidents(state)
+        if open_incs or len(closed) != 1:
+            return _fail(
+                f"expected 0 open / 1 closed incident, got "
+                f"{len(open_incs)} / {len(closed)}"
+            )
+        inc = closed[0]
+        if inc["rule"] != "fast-breaker" or not inc.get("duration_s", 0) > 0:
+            return _fail(f"closed incident malformed: {inc!r}")
+        if "budget_burn_x" not in inc:
+            return _fail(f"closed incident must carry budget_burn_x: {inc!r}")
+
+        # -- exit codes after recovery ------------------------------------
+        if obs_main(["alerts", "--state", state]) != 0:
+            return _fail("`obs alerts` after resolution must exit 0")
+        if obs_main(["incidents", "--state", state]) != 0:
+            return _fail("`obs incidents` with all closed must exit 0")
+
+    print(
+        f"alert smoke OK ({len(ticks)} ticks: fast-burn paged+resolved, "
+        f"slow-burn stayed pending, 1 incident closed after "
+        f"{inc['duration_s']:.0f}s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,15 +4,18 @@
 Dependency-free by design (stdlib only, like the exporter itself): boots
 the exporter on an ephemeral port (``TIP_OBS_HTTP=auto``), seeds the
 in-memory metrics registry, mounts /slo and /fleet providers plus health
-components, then curls all four routes over real HTTP and validates:
+components, then curls the live routes over real HTTP and validates:
 
 - ``/healthz`` answers 200 with ``ok: true``, flips to 503 when any
   component is pushed unhealthy, and recovers to 200;
 - ``/metrics`` is valid Prometheus text exposition — every line must
-  match the exposition-format line grammar, ``tip_up 1`` is present, and
-  the seeded counter/gauge/quantile families all render;
+  match the exposition-format line grammar, every ``# TYPE family`` line
+  is immediately preceded by a ``# HELP`` line for the same family,
+  ``tip_up 1`` is present, and the seeded counter/gauge/quantile
+  families all render;
 - ``/slo`` and ``/fleet`` serve the mounted provider JSON (and 404 once
-  the provider is cleared);
+  the provider is cleared); ``/alerts`` 404s while no evaluator is
+  mounted (the obs v5 route registers, it doesn't invent state);
 - unknown routes 404; a provider that raises answers 500 without
   killing the server;
 - a second ``start()`` is a no-op returning the same port, and the
@@ -120,11 +123,21 @@ def main() -> int:
         return _fail(f"/metrics expected 200, got {status}")
     if not text.endswith("\n"):
         return _fail("/metrics body must end with a trailing newline")
-    for line in text.splitlines():
+    lines = text.splitlines()
+    for line in lines:
         if not line:
             continue
         if not (_COMMENT.match(line) or _SAMPLE.match(line)):
             return _fail(f"/metrics line fails exposition grammar: {line!r}")
+    # Exposition hygiene (obs v5): no TYPE without a HELP for the family.
+    for i, line in enumerate(lines):
+        if line.startswith("# TYPE "):
+            fam = line.split()[2]
+            if i == 0 or not lines[i - 1].startswith(f"# HELP {fam} "):
+                return _fail(
+                    f"/metrics `# TYPE {fam}` not immediately preceded by "
+                    f"`# HELP {fam}`: {lines[max(0, i - 1):i + 1]!r}"
+                )
     for needle in (
         "tip_up 1",
         "tip_smoke_requests_total 3",
@@ -144,6 +157,11 @@ def main() -> int:
     status, _ = _get(port, "/nope")
     if status != 404:
         return _fail(f"unknown route expected 404, got {status}")
+    status, _ = _get(port, "/alerts")
+    if status != 404:
+        return _fail(
+            f"/alerts with no evaluator mounted expected 404, got {status}"
+        )
     exporter.set_provider("slo", lambda: 1 // 0)
     status, _ = _get(port, "/slo")
     if status != 500:
@@ -158,7 +176,7 @@ def main() -> int:
 
     exporter.reset()
     os.environ.pop("TIP_OBS_HTTP", None)
-    print(f"exporter smoke OK (served 4 routes on 127.0.0.1:{port})")
+    print(f"exporter smoke OK (served the live routes on 127.0.0.1:{port})")
 
     # -- live CLI one-shots against a real study trace --------------------
     if args.trace:

@@ -82,6 +82,13 @@ NON_PLANNER_KNOBS = frozenset(
         "TIP_OBS_MEMPOLL_S",
         "TIP_OBS_WORKER",
         "TIP_OBS_PLATFORM",
+        # alerting plane (obs/slo.py, obs/alerts.py): rule-document /
+        # state-file locations, sink routing and the evaluator cadence —
+        # operational surfaces, not searched plan dimensions
+        "TIP_ALERT_RULES",
+        "TIP_ALERT_STATE",
+        "TIP_ALERT_SINKS",
+        "TIP_ALERT_EVAL_S",
         # device cost observatory (obs/devicemeter.py) + the
         # healthy-window capture pilot (scripts/healthy_window.py):
         # calibration/operations knobs, not searched plan dimensions

@@ -27,6 +27,20 @@ coordinator's membership/lease view); ``live`` is the torn-tail-tolerant
 merged tail, the refreshing top table, and the predicted_s-vs-actual_s
 audit that feeds cost-model drift back through ``obs trend``.
 
+obs v5 adds the alerting plane: ``slo`` declares schema-stamped SLO/
+alert-rule documents (``$TIP_ASSETS/obs/slo_rules.json``,
+``TIP_ALERT_RULES`` override) with error budgets and Google-SRE-style
+multi-window multi-burn-rate thresholds over the existing metric
+families; ``alerts`` evaluates them on the owner loops (scheduler health
+cadence, fleet beat, serving scheduler), drives per-rule
+inactive→pending→firing→resolved state machines (file-backed, atomic,
+fencing-token-safe under the fleet), emits transitions to pluggable
+sinks (stderr, ``alerts.jsonl``, webhook-shaped file) plus the obs event
+stream, and opens/closes incident records correlating the alert window
+to spans, request_ids, breaker/chaos events and the active
+ExecutionPlan fingerprint. Surfaces: ``/alerts`` on the exporter and
+``python -m simple_tip_tpu.obs alerts|incidents``.
+
 obs v2 adds the trace lifecycle (``TIP_OBS_MAX_BYTES`` rotating size cap
 with oldest-segment eviction, ``TIP_OBS_SAMPLE`` keep-1-in-N span
 sampling, the ``study_root`` span every process's top spans nest under),
@@ -93,10 +107,13 @@ __all__ = [
 
 
 def reset_all() -> None:
-    """Full test-hook reset: tracer, metrics registry, log bridge, exporter."""
-    from simple_tip_tpu.obs import exporter, logbridge, metrics, tracer
+    """Full test-hook reset: tracer, metrics, log bridge, exporter, alerts."""
+    # alerts is imported lazily (never at module level) so the obs package
+    # root stays import-cycle-free: alerts imports exporter/metrics/slo.
+    from simple_tip_tpu.obs import alerts, exporter, logbridge, metrics, tracer
 
     tracer.reset()
     metrics.reset()
     logbridge.reset()
+    alerts.reset()  # before exporter.reset(): drops its /alerts provider
     exporter.reset()

@@ -36,6 +36,12 @@
 - ``roofline`` per-program MFU / achieved-bandwidth table with a
   compute-bound vs HBM-bound verdict per program (``obs/devicemeter.py``),
   from ``MFU_BREAKDOWN.json`` captures or a run's live dispatch gauges.
+- ``alerts``   the SLO evaluator's per-rule alert states and burn rates
+  from the persisted state file (``obs/alerts.py``); exit 1 while any
+  rule is firing, so a watch loop can page on the exit code alone.
+- ``incidents`` the incident timeline: open incidents from the state file
+  plus the closed records in ``incidents.jsonl``, each correlating its
+  alert window to spans, request_ids and the active plan fingerprint.
 
 Exit codes (``regress`` and ``trend``, so CI can tell skip from failure):
 **0** inside the band / no regression, **1** regression detected,
@@ -875,6 +881,34 @@ def main(argv=None) -> int:
         "--once", action="store_true", help="one-shot render (CI/tests)"
     )
 
+    alp = sub.add_parser(
+        "alerts",
+        help="per-rule alert states + burn rates from the evaluator's "
+        "state file (exit 0 quiet / 1 firing / 2 corrupt / 3 no state)",
+    )
+    alp.add_argument(
+        "--state", default=None, metavar="DIR",
+        help="alert-state directory (default: $TIP_ALERT_STATE or "
+        "$TIP_ASSETS/obs/alerts)",
+    )
+    alp.add_argument("--json", action="store_true", help="machine-readable output")
+
+    inp = sub.add_parser(
+        "incidents",
+        help="the incident timeline: open + closed incident records "
+        "(exit 0 closed-only / 1 open / 2 corrupt / 3 none)",
+    )
+    inp.add_argument(
+        "--state", default=None, metavar="DIR",
+        help="alert-state directory (default: $TIP_ALERT_STATE or "
+        "$TIP_ASSETS/obs/alerts)",
+    )
+    inp.add_argument(
+        "--limit", type=int, default=None, metavar="N",
+        help="only the newest N closed incidents",
+    )
+    inp.add_argument("--json", action="store_true", help="machine-readable output")
+
     audp = sub.add_parser(
         "audit",
         help="grade predicted_s vs actual_s across a run's phase spans; "
@@ -894,6 +928,15 @@ def main(argv=None) -> int:
     )
 
     args = ap.parse_args(argv)
+
+    if args.command in ("alerts", "incidents"):
+        from simple_tip_tpu.obs import alerts as alerts_mod
+
+        if args.command == "alerts":
+            return alerts_mod.cli_alerts(args.state, as_json=args.json)
+        return alerts_mod.cli_incidents(
+            args.state, as_json=args.json, limit=args.limit
+        )
 
     if args.command in ("tail", "top", "audit"):
         from simple_tip_tpu.obs import live as live_mod

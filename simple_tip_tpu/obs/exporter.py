@@ -1,4 +1,4 @@
-"""Live telemetry plane: /healthz /metrics /slo /fleet over stdlib HTTP.
+"""Live telemetry plane: /healthz /metrics /slo /fleet /alerts (stdlib HTTP).
 
 The rest of the obs stack is post-hoc — spans, the feature store and the
 trend gates all read JSONL after a run finishes. But the fleet (leases,
@@ -19,7 +19,10 @@ process mounts via :func:`start`:
   engine registered itself;
 - ``/fleet``    the coordinator-aggregated membership view (per-host
   heartbeat age + stale flag, lease epochs, in-flight units, straggler
-  verdicts), when a fleet mounted it.
+  verdicts), when a fleet mounted it;
+- ``/alerts``   the SLO evaluator's cached per-rule alert states, burn
+  rates and open incidents (obs/alerts.py), when an evaluator mounted
+  itself in this process.
 
 Knob contract mirrors ``TIP_OBS_DIR`` (see tracer): ``TIP_OBS_HTTP``
 unset / empty / ``0`` / ``off`` means NO-OP — no socket, no thread, no
@@ -66,7 +69,7 @@ _providers: Dict[str, Callable[[], dict]] = {}
 _health: Dict[str, Dict] = {}
 
 _NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
-ROUTES = ("/healthz", "/metrics", "/slo", "/fleet")
+ROUTES = ("/healthz", "/metrics", "/slo", "/fleet", "/alerts")
 
 
 def _resolve_port() -> Optional[int]:
@@ -126,6 +129,13 @@ def _fmt(v) -> str:
     return repr(float(v))
 
 
+def _help_line(fam: str, name: str) -> str:
+    """One ``# HELP`` line for family ``fam`` (description from the
+    registry; HELP text is single-line by the format's grammar)."""
+    text = " ".join(metrics.help_text(name).split()) or name
+    return f"# HELP {fam} {text}"
+
+
 def render_metrics(snap: Optional[dict] = None) -> str:
     """The registry snapshot as Prometheus text exposition format.
 
@@ -133,43 +143,63 @@ def render_metrics(snap: Optional[dict] = None) -> str:
     1:1; histograms (count/sum/min/max summaries) become a summary family
     plus ``_min``/``_max`` gauges; Quantile windows become summary
     families with ``quantile="0.5|0.95|0.99"`` labels. Non-numeric gauge
-    values are skipped — the text format has no string samples.
+    values are skipped — the text format has no string samples. Every
+    ``# TYPE`` is preceded by a ``# HELP`` with the family's registry
+    description (``metrics.describe``/``help_text``), pinned by
+    scripts/exporter_smoke.py's HELP/TYPE-pair check.
     """
     if snap is None:
         snap = metrics.snapshot()
-    lines = ["# TYPE tip_up gauge", "tip_up 1"]
+    lines = [
+        "# HELP tip_up exporter liveness (always 1 while serving)",
+        "# TYPE tip_up gauge",
+        "tip_up 1",
+    ]
     for name, v in (snap.get("counters") or {}).items():
         if not isinstance(v, (int, float)):
             continue
         fam = _san(name) + "_total"
+        lines.append(_help_line(fam, name))
         lines.append(f"# TYPE {fam} counter")
         lines.append(f"{fam} {_fmt(v)}")
     for name, v in (snap.get("gauges") or {}).items():
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             continue
         fam = _san(name)
+        lines.append(_help_line(fam, name))
         lines.append(f"# TYPE {fam} gauge")
         lines.append(f"{fam} {_fmt(v)}")
     for name, h in (snap.get("histograms") or {}).items():
         if not isinstance(h, dict):
             continue
         fam = _san(name)
+        lines.append(_help_line(fam, name))
         lines.append(f"# TYPE {fam} summary")
         lines.append(f"{fam}_count {_fmt(int(h.get('count') or 0))}")
         lines.append(f"{fam}_sum {_fmt(float(h.get('sum') or 0.0))}")
         for bound in ("min", "max"):
             if isinstance(h.get(bound), (int, float)):
+                lines.append(
+                    f"# HELP {fam}_{bound} {bound} observed by "
+                    f"{metrics.help_text(name)}"
+                )
                 lines.append(f"# TYPE {fam}_{bound} gauge")
                 lines.append(f"{fam}_{bound} {_fmt(h[bound])}")
     for name, q in (snap.get("quantiles") or {}).items():
         if not isinstance(q, dict):
             continue
         fam = _san(name)
+        lines.append(_help_line(fam, name))
         lines.append(f"# TYPE {fam} summary")
         for label, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
             if isinstance(q.get(key), (int, float)):
                 lines.append(f'{fam}{{quantile="{label}"}} {_fmt(q[key])}')
         lines.append(f"{fam}_count {_fmt(int(q.get('count') or 0))}")
+    if _health:
+        lines.append(
+            "# HELP tip_health_ok pushed component health (1 ok, 0 failing)"
+        )
+        lines.append("# TYPE tip_health_ok gauge")
     for component, rec in sorted(_health.items()):
         lines.append(
             f'tip_health_ok{{component="{_NAME_BAD.sub("_", component)}"}} '
@@ -200,7 +230,7 @@ def render_healthz() -> dict:
 
 
 class _Handler(BaseHTTPRequestHandler):
-    """Request handler for the four live routes.
+    """Request handler for the live routes.
 
     Reads ONLY in-memory state (the pushed health dict, the metrics
     registry snapshot, provider-cached views) — the blocking-endpoint
@@ -229,14 +259,14 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
     def do_GET(self) -> None:  # noqa: N802 — http.server's casing
-        """Serve one of the four routes from in-memory state."""
+        """Serve one of the live routes from in-memory state."""
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         if path == "/healthz":
             doc = render_healthz()
             self._reply_json(200 if doc["ok"] else 503, doc)
         elif path == "/metrics":
             self._reply(200, render_metrics(), "text/plain; version=0.0.4")
-        elif path in ("/slo", "/fleet"):
+        elif path in ("/slo", "/fleet", "/alerts"):
             provider = _providers.get(path[1:])
             if provider is None:
                 self._reply_json(
@@ -322,7 +352,7 @@ def stop() -> None:
 
 
 def set_provider(name: str, fn: Callable[[], dict]) -> None:
-    """Register the ``/slo`` or ``/fleet`` body source.
+    """Register the ``/slo``, ``/fleet`` or ``/alerts`` body source.
 
     ``fn`` runs on a request thread and MUST be an in-memory read (a
     cached view, the metrics registry) — never filesystem or device work.

@@ -37,6 +37,18 @@ from typing import Dict, List, Optional
 # poll against a dead study would be a hang.
 DEFAULT_FOLLOW_S = 86400.0
 _POLL_S = 0.5
+# Idle-backoff ceiling for follow mode: each poll that yields no bytes
+# doubles the interval up to this cap (reset to the base on activity), so
+# a quiet study doesn't busy-rescan its run directory twice a second.
+_POLL_CAP_S = 8.0
+
+
+def _next_poll_s(cur_s: float, base_s: float, active: bool) -> float:
+    """The next follow-mode poll interval: base while the streams are
+    producing, exponential backoff to ``_POLL_CAP_S`` while idle."""
+    if active:
+        return base_s
+    return min(max(base_s, cur_s) * 2.0, max(base_s, _POLL_CAP_S))
 
 # Version stamp on every emitted audit document: `obs audit --json` output
 # is a trend snapshot (regress.load_snapshot consumes it), so the docs
@@ -152,13 +164,17 @@ def iter_tail(
     polling (rediscovering new stream files each pass, so late-spawning
     workers join the merge) until ``duration_s`` passes or ``max_events``
     have been yielded; within one poll batch events are ts-sorted —
-    cross-poll order is arrival order, the live-tail contract.
+    cross-poll order is arrival order, the live-tail contract. Idle polls
+    back the interval off exponentially to ``_POLL_CAP_S`` (reset to
+    ``poll_s`` the moment a stream produces events) so a quiet study
+    isn't rescanned at full cadence.
     """
     cursors: Dict[str, StreamCursor] = {}
     deadline = time.monotonic() + (
         duration_s if duration_s is not None else DEFAULT_FOLLOW_S
     )
     yielded = 0
+    cur_poll = poll_s
     while True:
         live = set(_stream_paths(target))
         for path in live:
@@ -181,9 +197,11 @@ def iter_tail(
                 return
         if not follow:
             return
-        if time.monotonic() >= deadline:
+        now = time.monotonic()
+        if now >= deadline:
             return
-        time.sleep(poll_s)
+        cur_poll = _next_poll_s(cur_poll, poll_s, active=bool(batch))
+        time.sleep(min(cur_poll, deadline - now))
 
 
 def format_event(rec: dict, t0: Optional[float]) -> str:

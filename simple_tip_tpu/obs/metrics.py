@@ -33,6 +33,10 @@ _counters = {}
 _gauges = {}
 _hists = {}
 _quantiles = {}
+# Per-family descriptions for the exporter's `# HELP` lines: explicit
+# registrations via describe() win, then the standing-instrument table
+# below, then the family name itself (HELP must never be empty).
+_help = {}
 _jax_hooks_installed = False
 # json.dumps of the last snapshot this process flushed into the stream:
 # periodic pollers (the scheduler's device-memory poll) call flush() on a
@@ -165,6 +169,53 @@ class Quantile:
         }
 
 
+#: Descriptions for the standing instruments (module docstring table) —
+#: the /metrics HELP default when no seam registered its own text.
+_STANDING_HELP = {
+    "scheduler.requeues": "scheduler work units requeued after a worker loss",
+    "scheduler.timeouts": "scheduler work units that hit the run timeout",
+    "scheduler.worker_deaths": "scheduler worker processes that died mid-run",
+    "scheduler.journal_skips": "journal entries that skipped re-dispatch",
+    "scheduler.in_flight": "work units currently dispatched to workers",
+    "scheduler.outstanding": "work units not yet completed",
+    "journal.appends": "resilience journal records appended",
+    "breaker.opened": "circuit breaker transitions into OPEN",
+    "breaker.closed": "circuit breaker transitions back to CLOSED",
+    "breaker.short_circuit": "calls rejected while the breaker was OPEN",
+    "breaker.degraded": "calls served by the degraded fallback path",
+    "breaker.open": "1 while the circuit breaker is OPEN, else 0",
+    "retry.attempts": "retry-policy attempts across all scopes",
+    "retry.giveups": "retry-policy exhaustions (budget spent)",
+    "faults.injected": "chaos faults injected by the active fault plan",
+    "jax.compiles": "XLA backend compiles observed via jax.monitoring",
+    "jax.compile_seconds": "XLA backend compile wall time",
+    "serving.request_ms": "serving request latency window (SLO quantiles)",
+    "serving.rows": "rows admitted into serving badges",
+    "serving.shed": "rows shed by serving admission control",
+    "serving.scheduler_crashes": "serving engine scheduler-task deaths",
+    "serving.backend_errors": "serving backend dispatch errors",
+    "fleet.members_alive": "fleet members with a fresh heartbeat",
+}
+
+
+def describe(name: str, text: str) -> None:
+    """Register the ``# HELP`` description for metric family ``name``.
+
+    Owning seams call this once next to the instrument they create; the
+    exporter falls back to the standing table, then the name itself.
+    """
+    if text:
+        with _lock:
+            _help[name] = " ".join(str(text).split())
+
+
+def help_text(name: str) -> str:
+    """The HELP description for ``name`` (never empty)."""
+    with _lock:
+        text = _help.get(name)
+    return text or _STANDING_HELP.get(name) or str(name)
+
+
 def counter(name: str) -> Counter:
     """Get-or-create the counter ``name``."""
     with _lock:
@@ -274,6 +325,7 @@ def reset() -> None:
         _gauges.clear()
         _hists.clear()
         _quantiles.clear()
+        _help.clear()
         _jax_hooks_installed = False
         _last_flushed = None
 

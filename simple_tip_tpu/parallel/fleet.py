@@ -575,6 +575,7 @@ def run_phase_fleet(
     # coordinator-aggregated /fleet view (and /healthz//metrics) while the
     # fleet runs. No-op unless TIP_OBS_HTTP is set; members do not mount —
     # one port, one aggregated view.
+    from simple_tip_tpu.obs import alerts as alerts_mod
     from simple_tip_tpu.obs import exporter
 
     http_port = exporter.start()
@@ -622,20 +623,28 @@ def run_phase_fleet(
                     f"fleet did not drain within {deadline_s:.0f}s; "
                     f"unresolved: {_unresolved()}"
                 )
-            if http_port is not None and time.monotonic() >= next_view:
+            if (
+                http_port is not None or alerts_mod.enabled()
+            ) and time.monotonic() >= next_view:
                 # Refresh the cached /fleet view on the beat cadence from
                 # THIS loop — handler threads only ever read the cache.
+                # The SLO evaluator rides the same beat (its
+                # fleet-members-alive rule samples the gauge set here),
+                # with or without a live exporter.
                 next_view = time.monotonic() + probe.beat_interval_s
                 view = probe.fleet_view()
                 fleet_members = view.get("members", {})
                 alive = [
                     h for h, m in fleet_members.items() if not m.get("stale")
                 ]
-                exporter.set_health(
-                    "fleet", ok=bool(alive), members_alive=len(alive),
-                    members_total=len(fleet_members),
-                    unresolved=len(_unresolved()),
-                )
+                obs.gauge("fleet.members_alive").set(len(alive))
+                alerts_mod.tick()
+                if http_port is not None:
+                    exporter.set_health(
+                        "fleet", ok=bool(alive), members_alive=len(alive),
+                        members_total=len(fleet_members),
+                        unresolved=len(_unresolved()),
+                    )
             if not any(p.is_alive() for p in members):
                 if standbys >= max_standbys:
                     break  # nobody left and no standby budget: report below

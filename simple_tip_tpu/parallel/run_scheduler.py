@@ -441,6 +441,7 @@ def run_phase_parallel(
     # endpoint handlers read only in-memory state, so every filesystem-
     # backed health input (breaker state file, journal flock) is polled
     # HERE, on the scheduler loop's cadence, and pushed in.
+    from simple_tip_tpu.obs import alerts as alerts_mod
     from simple_tip_tpu.obs import exporter
     from simple_tip_tpu.resilience.breaker import CircuitBreaker
 
@@ -569,17 +570,26 @@ def run_phase_parallel(
     _wedge_polls = [0]  # consecutive wedged-journal probes (debounced)
 
     def _push_health() -> None:
-        """Poll the filesystem-backed health inputs and push them into the
-        exporter, plus the live scheduler gauges. Runs on the scheduler
-        loop (``_HEALTH_PUSH_S`` cadence) so HTTP handler threads never
-        touch the breaker state file or the journal flock themselves."""
+        """Poll the filesystem-backed health inputs, refresh the live
+        scheduler gauges, run one alert-evaluator tick, and push the
+        health components into the exporter. Runs on the scheduler loop
+        (``_HEALTH_PUSH_S`` cadence) so HTTP handler threads never touch
+        the breaker state file or the journal flock themselves — and the
+        SLO evaluator (obs/alerts.py) rides the same cadence, with or
+        without a live exporter to publish on."""
+        breaker_ok = True
+        if health_breaker is not None:
+            breaker_ok = health_breaker.healthy()
+            obs.gauge("breaker.open").set(0 if breaker_ok else 1)
+        outstanding = len(_outstanding())
+        obs.gauge("scheduler.in_flight").set(len(in_flight))
+        obs.gauge("scheduler.outstanding").set(outstanding)
+        alerts_mod.tick()
         if http_port is None:
             return
         if health_breaker is not None:
             exporter.set_health(
-                "breaker",
-                ok=health_breaker.healthy(),
-                **health_breaker.snapshot(),
+                "breaker", ok=breaker_ok, **health_breaker.snapshot()
             )
         if journal is not None:
             _wedge_polls[0] = _wedge_polls[0] + 1 if journal.wedged() else 0
@@ -589,14 +599,11 @@ def run_phase_parallel(
                 wedged_polls=_wedge_polls[0],
                 path=journal.path,
             )
-        outstanding = len(_outstanding())
         exporter.set_health(
             "scheduler", ok=True, phase=phase, case_study=case_study,
             outstanding=outstanding, in_flight=len(in_flight),
             workers_alive=sum(1 for w in workers if w.is_alive()),
         )
-        obs.gauge("scheduler.in_flight").set(len(in_flight))
-        obs.gauge("scheduler.outstanding").set(outstanding)
 
     def _fleet_tick() -> None:
         """One fleet housekeeping pass: heartbeat + coordinator duties,
@@ -853,7 +860,9 @@ def run_phase_parallel(
 
     while _outstanding():
         _fleet_tick()
-        if http_port is not None and time.monotonic() - last_health >= _HEALTH_PUSH_S:
+        # Runs whether or not the exporter is live: _push_health gates the
+        # HTTP pushes itself, and the alert evaluator rides this cadence.
+        if time.monotonic() - last_health >= _HEALTH_PUSH_S:
             last_health = time.monotonic()
             _push_health()
         if (

@@ -166,7 +166,12 @@ def active_plan():
 
 
 def active_plan_id() -> str:
-    """The active plan's id, or ``"unplanned"`` (the bench/record stamp)."""
+    """The active plan's id, or ``"unplanned"``.
+
+    Stamped into bench records, scheduler phase spans, and (obs v5) every
+    incident the alert evaluator opens — a page under a fresh plan points
+    at the plan first (RUNBOOK §11).
+    """
     doc = active_plan()
     return doc["plan_id"] if doc else UNPLANNED
 

@@ -146,6 +146,7 @@ class CircuitBreaker:
         if state != OPEN:
             return True
         obs.counter("breaker.short_circuit").inc()
+        obs.gauge("breaker.open").set(1)  # re-stamp the level each rejection
         st = self._load()
         now = time.time()  # cross-process timestamp, not a duration
         remaining = float(st.get("opened_ts", 0)) + self.cooldown_s - now
@@ -169,6 +170,9 @@ class CircuitBreaker:
             logger.warning(
                 "circuit breaker %r CLOSED: backend probe recovered", self.name
             )
+        # Level gauge next to the transition counters: the SLO engine's
+        # breaker-open rule samples state, not edges (obs/slo.py).
+        obs.gauge("breaker.open").set(0)
         self._store({"state": CLOSED, "failures": 0})
 
     def record_failure(self) -> None:
@@ -195,6 +199,9 @@ class CircuitBreaker:
                     "callers fail fast" if self.mode == "fail"
                     else "callers degrade to CPU, stamped degraded",
                 )
+            # Level gauge for the SLO engine's breaker-open rule: 1 for
+            # the whole open window, not just the transition edge.
+            obs.gauge("breaker.open").set(1)
             self._store(
                 {"state": OPEN, "failures": failures, "opened_ts": time.time()}
             )

@@ -47,7 +47,11 @@ production code; the plan decides whether anything happens there:
                       beat: the host is alive but the fleet stops seeing
                       it — the heartbeat-partition stand-in);
 - ``lease.steal``     a lease takeover attempt (``fail`` denies it — a
-                      standby that cannot take over; ``error`` raises).
+                      standby that cannot take over; ``error`` raises);
+- ``alerts.save``     the alert evaluator persisting its state file
+                      (``error`` raises before the atomic rename — the
+                      evaluator-killed-mid-persist stand-in the
+                      restart-resume tests pin).
 
 Kinds (``KINDS``): ``die``/``wedge``/``error`` are process-level and
 execute directly inside ``fire``; ``timeout``/``fail``/``corrupt``/
@@ -87,6 +91,7 @@ SITES = (
     "host.die",
     "heartbeat.drop",
     "lease.steal",
+    "alerts.save",
 )
 
 #: Process-level kinds executed by fire() itself, and seam-interpreted

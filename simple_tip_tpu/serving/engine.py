@@ -165,8 +165,10 @@ class ScoringEngine:
         if self._closed:
             return
         self._closed = True
+        from simple_tip_tpu.obs import alerts as alerts_mod
         from simple_tip_tpu.obs import exporter
 
+        alerts_mod.tick()  # final evaluation over the engine's last state
         if exporter.enabled():
             # Unhook /slo: a closed engine's snapshot would read as live.
             exporter.clear_provider("slo")
@@ -298,16 +300,31 @@ class ScoringEngine:
     # -- scheduler -----------------------------------------------------------
 
     async def _run(self) -> None:
-        """The scheduler loop: wait for work/deadline, assemble, dispatch."""
+        """The scheduler loop: wait for work/deadline, assemble, dispatch.
+
+        Also the serving process's SLO-evaluator mount: one rate-limited
+        ``alerts.tick()`` per wakeup (obs/alerts.py self-gates on its own
+        cadence and on whether any rules are configured), so a p99 or
+        shed-rate burn pages from inside the engine without a sidecar.
+        The wait is capped at the evaluator cadence only while rules are
+        configured — an idle engine with no alerting sleeps untouched.
+        """
+        from simple_tip_tpu.obs import alerts as alerts_mod
+
         loop = asyncio.get_running_loop()
+        alerting = alerts_mod.enabled()
         while not self._closed:
             deadline = self.batcher.next_deadline()
             timeout = None if deadline is None else max(0.0, deadline - loop.time())
+            if alerting and (timeout is None or timeout > 1.0):
+                timeout = 1.0
             try:
                 await asyncio.wait_for(self._wake.wait(), timeout)
             except asyncio.TimeoutError:
                 pass
             self._wake.clear()
+            if alerting:
+                alerts_mod.tick()
             while not self._closed:
                 badge = self.batcher.take_ready(loop.time())
                 if badge is None:
@@ -325,8 +342,13 @@ class ScoringEngine:
         logger.error("serving scheduler task died: %r", exc)
         obs.counter("serving.scheduler_crashes").inc()
         obs.event("serving.scheduler_crash", error=repr(exc)[:200])
+        from simple_tip_tpu.obs import alerts as alerts_mod
         from simple_tip_tpu.obs import exporter
 
+        # The loop that would have ticked the evaluator just died: run one
+        # tick now so the crash counter lands in a sample before the
+        # process (possibly) exits.
+        alerts_mod.tick()
         if exporter.enabled():
             # Flip /healthz to 503: the engine can no longer serve.
             exporter.set_health("serving", ok=False, error=repr(exc)[:200])

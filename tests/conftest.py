@@ -69,6 +69,14 @@ for _var in ["TIP_DEVICE_PEAKS", "TIP_HEALTHZ_URL"] + [
 ]:
     os.environ.pop(_var, None)
 
+# An inherited alert-rule document or state directory would mount the SLO
+# evaluator under every scheduler/fleet/serving test (alert transitions
+# writing into a real operator state file, plus a per-tick evaluation cost
+# the no-op pins don't budget for). Cleared here; the alert tests opt in
+# per-test via monkeypatch + alerts.reset().
+for _var in [v for v in os.environ if v.startswith("TIP_ALERT_")]:
+    os.environ.pop(_var, None)
+
 # An inherited TIP_PLAN_FILE would silently activate an ExecutionPlan under
 # every scheduler/serving/bench test (plan-based estimates replacing the
 # cost-model fallbacks the tests pin); the other TIP_PLAN_* knobs would

@@ -1,0 +1,561 @@
+"""SLO engine + alerting plane tests (obs v5).
+
+Covers the declarative half (rule-document resolution, validation,
+sampling, burn-rate math — obs/slo.py), the procedural half (state
+machine, fenced persistence, sinks, incidents — obs/alerts.py), the
+surfaces (obs alerts / obs incidents CLI exit codes, the /alerts route),
+and the ISSUE's chaos-pinned acceptance: a breaker-open and an
+engine-kill each drive a rule pending→firing with a correlated incident
+then resolved after recovery, and a firing alert survives an evaluator
+killed mid-persist (the ``alerts.save`` fault seam) with its original
+start timestamp, resolving exactly once.
+
+All jax-free: the alerting plane is stdlib-only by construction.
+"""
+
+import asyncio
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import simple_tip_tpu.obs as obs
+from simple_tip_tpu.obs import alerts, slo
+from simple_tip_tpu.obs.cli import main as obs_main
+from simple_tip_tpu.resilience.breaker import CircuitBreaker
+from simple_tip_tpu.resilience.faults import InjectedFault
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs(tmp_path, monkeypatch):
+    """Isolate registry, evaluator singleton, and the alert state dir."""
+    monkeypatch.setenv("TIP_ALERT_STATE", str(tmp_path / "alerts"))
+    obs.reset_all()
+    yield
+    obs.reset_all()
+
+
+def _rules(**overrides):
+    """A one-rule document over the breaker gauge with test-sized windows."""
+    rule = {
+        "name": "breaker-open",
+        "severity": "page",
+        "budget": 0.05,
+        "for_s": 2.0,
+        "objective": {
+            "kind": "gauge", "metric": "breaker.open",
+            "op": "<=", "threshold": 0.0,
+        },
+        "windows": {
+            "fast": {"window_s": 10.0, "burn": 1.0},
+            "slow": {"window_s": 30.0, "burn": 0.5},
+        },
+    }
+    rule.update(overrides)
+    return {"schema": 1, "rules": [rule]}
+
+
+def _snap(counters=None, gauges=None, quantiles=None):
+    snap = {
+        "counters": counters or {},
+        "gauges": gauges or {},
+        "histograms": {},
+    }
+    if quantiles:
+        snap["quantiles"] = quantiles
+    return snap
+
+
+def _evaluator(doc, tmp_path, monkeypatch=None, **kw):
+    return alerts.Evaluator(
+        rules_doc={"schema": 1, "source": "test",
+                   "rules": slo.validate(doc["rules"])[0]},
+        state_dir=str(tmp_path / "alerts"),
+        min_interval_s=0.0,
+        **kw,
+    )
+
+
+# --- rule documents (slo.py) -------------------------------------------------
+
+
+def test_load_rules_resolution_grammar(tmp_path, monkeypatch):
+    monkeypatch.setenv("TIP_ASSETS", str(tmp_path))
+    # off states
+    for off in ("0", "off"):
+        monkeypatch.setenv(slo.RULES_ENV, off)
+        assert slo.load_rules() is None
+        assert not slo.rules_configured()
+    # unset + no standing document: off
+    monkeypatch.delenv(slo.RULES_ENV, raising=False)
+    assert slo.load_rules() is None
+    assert not slo.rules_configured()
+    # builtin
+    monkeypatch.setenv(slo.RULES_ENV, "builtin")
+    doc = slo.load_rules()
+    assert doc["source"] == "builtin" and len(doc["rules"]) == 7
+    # inline JSON
+    monkeypatch.setenv(slo.RULES_ENV, json.dumps(_rules()))
+    doc = slo.load_rules()
+    assert doc["source"] == "inline"
+    assert doc["rules"][0]["name"] == "breaker-open"
+    # @file and bare-path forms
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps(_rules()))
+    for form in (f"@{path}", str(path)):
+        monkeypatch.setenv(slo.RULES_ENV, form)
+        assert slo.load_rules()["rules"][0]["name"] == "breaker-open"
+    # unset + standing document at $TIP_ASSETS/obs/slo_rules.json
+    monkeypatch.delenv(slo.RULES_ENV, raising=False)
+    written = slo.write_default_rules()
+    assert written == slo.default_rules_path()
+    assert slo.rules_configured()
+    assert len(slo.load_rules()["rules"]) == 7
+
+
+def test_load_rules_requires_schema_stamp(monkeypatch):
+    naked = {"rules": _rules()["rules"]}
+    monkeypatch.setenv(slo.RULES_ENV, json.dumps(naked))
+    assert slo.load_rules() is None
+    stamped = dict(naked, schema=1)
+    monkeypatch.setenv(slo.RULES_ENV, json.dumps(stamped))
+    assert slo.load_rules() is not None
+
+
+def test_validate_drops_bad_rules_keeps_good():
+    good = _rules()["rules"][0]
+    rules, problems = slo.validate([
+        good,
+        {"name": "dup", "objective": {"kind": "nope"}},
+        {"objective": good["objective"], "budget": 0.1},          # no name
+        dict(good, name="bad-budget", budget=2.0),
+        dict(good, name="bad-window",
+             windows={"fast": {"window_s": -1}, "slow": {}}),
+        dict(good, name="breaker-open"),                          # duplicate
+    ])
+    assert [r["name"] for r in rules] == ["breaker-open"]
+    assert len(problems) == 5
+    # normalized shape: windows + for_s always present
+    r = rules[0]
+    assert r["windows"]["fast"]["burn"] == 1.0 and r["for_s"] == 2.0
+
+
+def test_sample_rule_all_kinds():
+    mk = lambda obj, **kw: dict(  # noqa: E731 — local table builder
+        {"name": "r", "severity": "page", "budget": 0.1, "objective": obj},
+        **kw,
+    )
+    rule = slo.validate([mk({"kind": "quantile", "metric": "serving.request_ms",
+                             "field": "p99", "op": "<=", "threshold": 100})])[0][0]
+    assert slo.sample_rule(rule, _snap()) is None  # never observed: no sample
+    s = slo.sample_rule(rule, _snap(quantiles={"serving.request_ms": {"p99": 250}}))
+    assert s == {"value": 250.0, "bad": 1.0}
+    s = slo.sample_rule(rule, _snap(quantiles={"serving.request_ms": {"p99": 50}}))
+    assert s["bad"] == 0.0
+
+    rule = slo.validate([mk({"kind": "gauge", "metric": "fleet.members_alive",
+                             "op": ">=", "threshold": 1})])[0][0]
+    assert slo.sample_rule(rule, _snap(gauges={"fleet.members_alive": 0}))["bad"] == 1.0
+
+    rule = slo.validate([mk({"kind": "ratio", "num": "serving.shed",
+                             "den": ["serving.rows", "serving.shed"]})])[0][0]
+    assert slo.sample_rule(rule, _snap(counters={"serving.shed": 5})) is None
+    s = slo.sample_rule(
+        rule, _snap(counters={"serving.shed": 5, "serving.rows": 15}),
+        prev_counters={"serving.shed": 0, "serving.rows": 0},
+    )
+    assert s == {"value": 0.25, "bad": 0.25}  # the rate IS the bad fraction
+    assert slo.sample_rule(  # no traffic between ticks: nothing to grade
+        rule, _snap(counters={"serving.shed": 5, "serving.rows": 15}),
+        prev_counters={"serving.shed": 5, "serving.rows": 15},
+    ) is None
+
+    rule = slo.validate([mk({"kind": "counter_delta",
+                             "metrics": ["scheduler.requeues"]})])[0][0]
+    s = slo.sample_rule(rule, _snap(counters={"scheduler.requeues": 3}),
+                        prev_counters={"scheduler.requeues": 1})
+    assert s == {"value": 2.0, "bad": 1.0}
+    s = slo.sample_rule(rule, _snap(counters={"scheduler.requeues": 3}),
+                        prev_counters={"scheduler.requeues": 3})
+    assert s["bad"] == 0.0
+
+    rule = slo.validate([mk({"kind": "index", "phase_prefix": "mfu.",
+                             "op": ">=", "threshold": 0.05, "agg": "mean"})])[0][0]
+    rows = [{"phase": "mfu.joint", "value": 0.02},
+            {"phase": "mfu.prio", "value": 0.04},
+            {"phase": "audit.fit", "value": 99.0}]
+    s = slo.sample_rule(rule, _snap(), index_rows=rows)
+    assert s == {"value": pytest.approx(0.03), "bad": 1.0}
+    assert slo.sample_rule(rule, _snap(), index_rows=[]) is None
+
+
+def test_burn_rate_windows_and_prune():
+    samples = [[t, 1.0 if t < 5 else 0.0] for t in range(10)]
+    assert slo.burn_rate(samples, now=9, window_s=4.0, budget=0.1) == 0.0
+    assert slo.burn_rate(samples, now=4, window_s=4.0, budget=0.1) == pytest.approx(10.0)
+    assert slo.burn_rate([], now=9, window_s=4.0, budget=0.1) is None
+    assert slo.burn_rate(samples, now=100, window_s=4.0, budget=0.1) is None
+    pruned = slo.prune_samples(samples, now=9, keep_s=3.0)
+    assert [s[0] for s in pruned] == [7, 8, 9]
+    assert len(slo.prune_samples(samples, now=9, keep_s=100.0, cap=4)) == 4
+
+
+# --- the state machine -------------------------------------------------------
+
+
+def test_state_machine_pending_firing_resolved(tmp_path, monkeypatch):
+    monkeypatch.setenv("TIP_ALERT_SINKS", "jsonl")
+    ev = _evaluator(_rules(), tmp_path)
+    base = time.time()
+    for i in range(3):
+        ev.evaluate(_snap(gauges={"breaker.open": 0}), now=base + i)
+    assert ev.view()["rules"][0]["state"] == "inactive"
+    trans = []
+    for i in range(3, 12):
+        trans += ev.evaluate(_snap(gauges={"breaker.open": 1}), now=base + i)
+    assert [(t["from"], t["to"]) for t in trans] == [
+        ("inactive", "pending"), ("pending", "firing"),
+    ]
+    firing = [t for t in trans if t["to"] == "firing"][0]
+    assert firing["severity"] == "page" and firing["incident"]
+    assert ev.view()["firing"] == 1
+    assert len(ev.view()["incidents_open"]) == 1
+    trans = []
+    for i in range(12, 60):
+        trans += ev.evaluate(_snap(gauges={"breaker.open": 0}), now=base + i)
+    assert [(t["from"], t["to"]) for t in trans] == [("firing", "resolved")]
+    assert trans[0]["incident"] == firing["incident"]
+    assert ev.view()["firing"] == 0
+    # the jsonl sink logged every transition, schema-stamped
+    lines = [json.loads(x) for x in
+             open(alerts.alerts_log_path(ev.store.state_dir))]
+    assert [x["to"] for x in lines] == ["pending", "firing", "resolved"]
+    assert all(x["schema"] == alerts.SCHEMA for x in lines)
+
+
+def test_for_s_hold_gates_firing(tmp_path, monkeypatch):
+    monkeypatch.setenv("TIP_ALERT_SINKS", "off")
+    ev = _evaluator(_rules(for_s=5.0), tmp_path)
+    base = time.time()
+    trans = []
+    for i in range(4):  # hot, but held < for_s
+        trans += ev.evaluate(_snap(gauges={"breaker.open": 1}), now=base + i)
+    assert [t["to"] for t in trans] == ["pending"]
+    trans = ev.evaluate(_snap(gauges={"breaker.open": 1}), now=base + 5.5)
+    assert [t["to"] for t in trans] == ["firing"]
+
+
+def test_slow_burn_only_warns_pending_never_fires(tmp_path, monkeypatch):
+    """A burn hot on the slow window but cool on the fast one is the
+    slow-leak shape: warn (pending), never page (firing)."""
+    monkeypatch.setenv("TIP_ALERT_SINKS", "off")
+    # fast burn 12 is unreachable (max possible = 1.0/0.1 = 10): only the
+    # slow window can go hot, which is exactly the slow-leak signature.
+    doc = _rules(windows={"fast": {"window_s": 4.0, "burn": 12.0},
+                          "slow": {"window_s": 40.0, "burn": 2.0}},
+                 budget=0.1, for_s=0.0)
+    ev = _evaluator(doc, tmp_path)
+    base = time.time()
+    trans = []
+    for i in range(40):
+        # 1 bad tick in 4: slow burn → 2.5 ≥ 2.0 (hot) as the window fills
+        bad = 1 if i % 4 == 0 else 0
+        trans += ev.evaluate(_snap(gauges={"breaker.open": bad}), now=base + i)
+    states = [t["to"] for t in trans]
+    assert "firing" not in states and "pending" in states
+    assert ev.view()["rules"][0]["state"] == "pending"
+
+
+def test_fencing_stale_evaluator_drops_its_transitions(tmp_path, monkeypatch):
+    """Two fleet members evaluating the same state dir: the one whose
+    fence is stale must adopt the winner's state, not clobber it."""
+    monkeypatch.setenv("TIP_ALERT_SINKS", "jsonl")
+    base = time.time()
+    ev1 = _evaluator(_rules(for_s=0.0), tmp_path)
+    ev2 = _evaluator(_rules(for_s=0.0), tmp_path)
+    # ev2 advances the fence several times while ev1 sits stale
+    for i in range(3):
+        ev2.evaluate(_snap(gauges={"breaker.open": 0}), now=base + i)
+    fence_after_ev2 = ev2._doc["fence"]
+    # ev1 (stale fence) computes a firing transition — the save must lose
+    trans = ev1.evaluate(_snap(gauges={"breaker.open": 1}), now=base + 3)
+    assert trans == []  # dropped: the winner owns the history
+    assert ev1._doc["fence"] >= fence_after_ev2  # adopted the disk state
+    assert ev1._doc["rules"]["breaker-open"]["state"] != "firing"
+    log = alerts.alerts_log_path(str(tmp_path / "alerts"))
+    assert not os.path.exists(log)  # no transition was ever emitted
+
+
+def test_alert_state_survives_evaluator_restart(tmp_path, monkeypatch):
+    """Satellite: kill the evaluator mid-persist (alerts.save fault seam),
+    restart, and the firing alert survives with its ORIGINAL start
+    timestamp and resolves exactly once."""
+    monkeypatch.setenv("TIP_ALERT_SINKS", "jsonl")
+    base = time.time()
+    ev1 = _evaluator(_rules(for_s=1.0), tmp_path)
+    for i in range(6):
+        ev1.evaluate(_snap(gauges={"breaker.open": 1}), now=base + i)
+    started = ev1._doc["rules"]["breaker-open"]["started_ts"]
+    assert ev1._doc["rules"]["breaker-open"]["state"] == "firing"
+    assert started is not None
+
+    # The next persist dies mid-save: the resolve transition it was about
+    # to commit never lands on disk and is never emitted.
+    monkeypatch.setenv("TIP_FAULT_PLAN", json.dumps({
+        "state_dir": str(tmp_path / "faults"),
+        "faults": [{"site": "alerts.save", "kind": "error", "times": 1}],
+    }))
+    with pytest.raises(InjectedFault):
+        for i in range(6, 60):
+            ev1.evaluate(_snap(gauges={"breaker.open": 0}), now=base + i)
+    monkeypatch.delenv("TIP_FAULT_PLAN")
+    del ev1  # the killed evaluator never comes back
+
+    ev2 = _evaluator(_rules(for_s=1.0), tmp_path)
+    rs = ev2._doc["rules"]["breaker-open"]
+    assert rs["state"] == "firing"            # resumed, not reset
+    assert rs["started_ts"] == started        # original start survives
+    trans = []
+    for i in range(6, 60):
+        trans += ev2.evaluate(_snap(gauges={"breaker.open": 0}), now=base + i)
+    assert [t["to"] for t in trans] == ["resolved"]
+    assert trans[0]["started_ts"] == started
+    lines = [json.loads(x) for x in
+             open(alerts.alerts_log_path(ev2.store.state_dir))]
+    assert sum(1 for x in lines if x["to"] == "resolved") == 1
+    assert sum(1 for x in lines if x["to"] == "firing") == 1
+
+
+# --- chaos acceptance --------------------------------------------------------
+
+
+def test_chaos_breaker_open_fires_with_correlated_incident(
+    tmp_path, monkeypatch
+):
+    """ISSUE acceptance: a breaker-open takes the breaker rule
+    pending→firing with an incident correlating spans, request_ids and
+    breaker events, then resolved after recovery."""
+    monkeypatch.setenv("TIP_OBS_DIR", str(tmp_path / "run"))
+    monkeypatch.setenv("TIP_ALERT_SINKS", "jsonl")
+    obs.reset_all()
+    monkeypatch.setenv("TIP_ALERT_STATE", str(tmp_path / "alerts"))
+
+    # Activity the incident should correlate: a badge span carrying
+    # request_ids, written into the run's obs stream.
+    with obs.span("serving.badge", request_ids="req-7,req-8"):
+        pass
+
+    br = CircuitBreaker(
+        state_path=str(tmp_path / "breaker.json"), threshold=2, cooldown_s=0.05
+    )
+    br.record_failure()
+    br.record_failure()  # threshold hit: OPEN + breaker.open gauge = 1
+    assert obs.metrics_snapshot()["gauges"]["breaker.open"] == 1
+
+    ev = _evaluator(_rules(for_s=2.0), tmp_path)
+    base = time.time()
+    trans = []
+    for i in range(6):
+        trans += ev.evaluate(obs.metrics_snapshot(), now=base + i)
+    assert [t["to"] for t in trans] == ["pending", "firing"]
+    inc = ev.view()["incidents_open"][0]
+    assert inc["plan"] == "unplanned"  # the active ExecutionPlan fingerprint
+    assert "serving.badge" in inc["correlated"]["spans"]
+    assert {"req-7", "req-8"} <= set(inc["correlated"]["request_ids"])
+    assert any(n.startswith("breaker.") for n in inc["correlated"]["events"])
+
+    # Recovery: cooldown elapses, a probe succeeds, the breaker closes.
+    time.sleep(0.06)
+    assert br.state() == "half_open"
+    br.record_success()
+    assert obs.metrics_snapshot()["gauges"]["breaker.open"] == 0
+    trans = []
+    for i in range(6, 60):
+        trans += ev.evaluate(obs.metrics_snapshot(), now=base + i)
+    assert [t["to"] for t in trans] == ["resolved"]
+    _open, closed = alerts.load_incidents(ev.store.state_dir)
+    assert not _open and len(closed) == 1
+    assert closed[0]["id"] == inc["id"] and closed[0]["duration_s"] > 0
+
+
+def test_chaos_engine_kill_fires_and_resolves(tmp_path, monkeypatch):
+    """ISSUE acceptance: an engine kill mid-stream (the scheduler-task
+    death seam) moves a scheduler-crash rule pending→firing→resolved."""
+    from simple_tip_tpu.serving import (
+        EngineClosed, ScoringEngine, ServingKnobs, StubExecutor,
+    )
+
+    monkeypatch.setenv("TIP_ALERT_SINKS", "off")
+    doc = {
+        "schema": 1,
+        "rules": [{
+            "name": "serving-crash", "severity": "page", "budget": 0.2,
+            "for_s": 1.0,
+            "objective": {"kind": "counter_delta",
+                          "metrics": ["serving.scheduler_crashes"]},
+            "windows": {"fast": {"window_s": 6.0, "burn": 1.0},
+                        "slow": {"window_s": 20.0, "burn": 0.5}},
+        }],
+    }
+    ev = _evaluator(doc, tmp_path)
+    base = time.time()
+    for i in range(2):  # healthy baseline (seeds prev_counters)
+        ev.evaluate(obs.metrics_snapshot(), now=base + i)
+
+    async def scenario():
+        eng = ScoringEngine(
+            StubExecutor(),
+            knobs=ServingKnobs(max_badge=4, flush_deadline_s=0.005),
+        )
+        eng.register_model("m")
+        await eng.start()
+
+        def boom(now, force=False):
+            raise RuntimeError("injected scheduler bug")
+
+        eng.batcher.take_ready = boom
+        with pytest.raises(EngineClosed, match="scheduler task died"):
+            await eng.score("m", [[1]])
+
+    asyncio.run(asyncio.wait_for(scenario(), 30.0))
+    assert obs.metrics_snapshot()["counters"]["serving.scheduler_crashes"] == 1
+
+    trans = []
+    for i in range(2, 8):  # the crash tick + the for_s hold
+        trans += ev.evaluate(obs.metrics_snapshot(), now=base + i)
+    assert [t["to"] for t in trans] == ["pending", "firing"]
+    trans = []
+    for i in range(8, 40):  # recovery: the counter stops moving
+        trans += ev.evaluate(obs.metrics_snapshot(), now=base + i)
+    assert [t["to"] for t in trans] == ["resolved"]
+    _open, closed = alerts.load_incidents(ev.store.state_dir)
+    assert not _open and closed[0]["rule"] == "serving-crash"
+
+
+# --- surfaces ----------------------------------------------------------------
+
+
+def test_cli_exit_codes_and_json(tmp_path, monkeypatch, capsys):
+    state = str(tmp_path / "alerts")
+    # 3: no evaluator ever ran
+    assert obs_main(["alerts", "--state", state]) == 3
+    assert obs_main(["incidents", "--state", state]) == 3
+
+    monkeypatch.setenv("TIP_ALERT_SINKS", "off")
+    ev = _evaluator(_rules(for_s=0.0), tmp_path)
+    base = time.time()
+    for i in range(3):
+        ev.evaluate(_snap(gauges={"breaker.open": 1}), now=base + i)
+    capsys.readouterr()
+    # 1: firing (and --json carries the full state document)
+    assert obs_main(["alerts", "--state", state, "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == 1
+    assert doc["rules"]["breaker-open"]["state"] == "firing"
+    assert obs_main(["incidents", "--state", state]) == 1  # incident open
+
+    for i in range(3, 50):
+        ev.evaluate(_snap(gauges={"breaker.open": 0}), now=base + i)
+    capsys.readouterr()
+    assert obs_main(["alerts", "--state", state]) == 0
+    out = capsys.readouterr().out
+    assert "breaker-open" in out and "resolved" in out
+    assert obs_main(["incidents", "--state", state, "--json"]) == 0
+    inc_doc = json.loads(capsys.readouterr().out)
+    assert len(inc_doc["closed"]) == 1 and not inc_doc["open"]
+
+    # 2: corrupt state file
+    with open(os.path.join(state, "alert_state.json"), "w") as f:
+        f.write("{not json")
+    assert obs_main(["alerts", "--state", state]) == 2
+    assert obs_main(["incidents", "--state", state]) == 2
+
+
+def test_alerts_endpoint_serves_the_evaluator_view(tmp_path, monkeypatch):
+    """The /alerts route and the CLI render the same state, each from its
+    own source (cached in-memory view vs the persisted file)."""
+    from simple_tip_tpu.obs import exporter
+
+    monkeypatch.setenv("TIP_OBS_HTTP", "auto")
+    port = exporter.start()
+    assert port is not None
+    try:
+        # Unmounted: 404, named in the error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/alerts", timeout=5)
+        assert e.value.code == 404
+
+        monkeypatch.setenv("TIP_ALERT_SINKS", "off")
+        ev = _evaluator(_rules(for_s=0.0), tmp_path)
+        base = time.time()
+        for i in range(3):
+            ev.evaluate(_snap(gauges={"breaker.open": 1}), now=base + i)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/alerts", timeout=5
+        ).read()
+        doc = json.loads(body)
+        assert doc["schema"] == 1 and doc["firing"] == 1
+        assert doc["rules"][0]["rule"] == "breaker-open"
+        assert doc["rules"][0]["state"] == "firing"
+        assert doc["incidents_open"][0]["rule"] == "breaker-open"
+        # same verdict as the file-backed CLI reader
+        persisted = alerts.load_state(ev.store.state_dir)
+        assert persisted["rules"]["breaker-open"]["state"] == "firing"
+    finally:
+        exporter.stop()
+
+
+def test_module_tick_is_a_noop_without_rules(monkeypatch):
+    monkeypatch.delenv(slo.RULES_ENV, raising=False)
+    monkeypatch.setenv("TIP_ASSETS", "/nonexistent-tip-assets")
+    assert not alerts.enabled()
+    alerts.tick()  # must not raise, must not create state
+    assert alerts.get(create=True) is None
+
+
+def test_module_singleton_created_when_configured(tmp_path, monkeypatch):
+    monkeypatch.setenv(slo.RULES_ENV, json.dumps(_rules()))
+    monkeypatch.setenv("TIP_ALERT_SINKS", "off")
+    assert alerts.enabled()
+    alerts.tick()
+    ev = alerts.get(create=False)
+    assert ev is not None and ev.enabled
+    alerts.reset()
+    assert alerts.get(create=False) is None
+
+
+def test_webhook_sink_writes_post_shaped_records(tmp_path, monkeypatch):
+    hook = tmp_path / "hook.jsonl"
+    monkeypatch.setenv("TIP_ALERT_SINKS", f"webhook:{hook}")
+    ev = _evaluator(_rules(for_s=0.0), tmp_path)
+    base = time.time()
+    for i in range(3):
+        ev.evaluate(_snap(gauges={"breaker.open": 1}), now=base + i)
+    recs = [json.loads(x) for x in open(hook)]
+    assert recs and all(r["method"] == "POST" and r["path"] == "/alert"
+                        for r in recs)
+    assert recs[-1]["body"]["to"] == "firing"
+    assert recs[-1]["body"]["rule"] == "breaker-open"
+
+
+def test_alert_transitions_land_in_the_obs_stream(tmp_path, monkeypatch):
+    from simple_tip_tpu.obs.cli import load_events
+
+    monkeypatch.setenv("TIP_OBS_DIR", str(tmp_path / "run"))
+    monkeypatch.setenv("TIP_ALERT_SINKS", "off")
+    obs.reset_all()
+    monkeypatch.setenv("TIP_ALERT_STATE", str(tmp_path / "alerts"))
+    ev = _evaluator(_rules(for_s=0.0), tmp_path)
+    base = time.time()
+    for i in range(3):
+        ev.evaluate(_snap(gauges={"breaker.open": 1}), now=base + i)
+    obs.reset()  # flush the stream
+    events, _files, _bad = load_events(str(tmp_path / "run"))
+    names = [e.get("name") for e in events if e.get("type") == "event"]
+    assert "alert.firing" in names
+    firing = [e for e in events if e.get("name") == "alert.firing"][0]
+    assert firing["attrs"]["schema"] == alerts.SCHEMA
+    assert firing["attrs"]["rule"] == "breaker-open"

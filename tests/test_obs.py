@@ -1420,6 +1420,40 @@ def test_metrics_route_is_valid_prometheus_text(http_exporter):
     assert 'tip_health_ok{component="sched"} 1' in text
 
 
+def test_every_type_line_has_a_help_line(http_exporter):
+    """Prometheus hygiene: every ``# TYPE fam`` is immediately preceded by
+    a ``# HELP fam`` for the same family — standing table for the known
+    metric names, describe() registrations winning over it, and the
+    metric's own name as the never-empty fallback."""
+    from simple_tip_tpu.obs import metrics
+
+    obs.counter("scheduler.requeues").inc()      # standing-help name
+    metrics.describe("live.described", "operator-provided help text")
+    obs.gauge("live.described").set(1)
+    obs.counter("live.undocumented").inc()       # falls back to the name
+    obs.quantile("live.req_ms").observe(5.0)
+    obs.histogram("live.batch_s").observe(0.5)
+    exporter.set_health("sched", ok=True)
+    _, text = _get(http_exporter, "/metrics")
+    lines = text.splitlines()
+    for i, line in enumerate(lines):
+        if line.startswith("# TYPE "):
+            fam = line.split()[2]
+            assert i > 0 and lines[i - 1].startswith(f"# HELP {fam} "), (
+                f"TYPE without a paired HELP for {fam}: {line}"
+            )
+    assert any(
+        l.startswith("# HELP tip_scheduler_requeues_total ")
+        and "requeue" in l
+        for l in lines
+    ), "standing help table entry should describe the known counter"
+    assert "# HELP tip_live_described operator-provided help text" in lines
+    assert any(
+        l == "# HELP tip_live_undocumented_total live.undocumented"
+        for l in lines
+    ), "unknown metrics fall back to their own name as HELP"
+
+
 def test_provider_routes_serve_clear_and_survive_raises(http_exporter):
     exporter.set_provider("slo", lambda: {"queue_rows": 3})
     exporter.set_provider("fleet", lambda: {"members": []})
@@ -1585,6 +1619,22 @@ def test_tail_follow_picks_up_live_appends_and_new_files(tmp_path):
     ]
     t.join()
     assert got == ["meta", "n1", "n2"]
+
+
+def test_tail_follow_idle_backoff_doubles_and_resets():
+    """Idle polls double up to the cap; any activity snaps back to base."""
+    base = 0.05
+    cur = base
+    seen = []
+    for _ in range(12):
+        cur = live._next_poll_s(cur, base, active=False)
+        seen.append(cur)
+    assert seen[:4] == [0.1, 0.2, 0.4, 0.8]
+    assert seen[-1] == live._POLL_CAP_S  # clamped, never runaway
+    assert live._next_poll_s(cur, base, active=True) == base  # reset
+    # a base above the cap is honored, not clamped down — but it also
+    # never backs off further (the operator already asked for slow polls)
+    assert live._next_poll_s(20.0, 20.0, active=False) == 20.0
 
 
 def test_top_snapshot_counts_lifecycle_and_queue(tmp_path):
